@@ -1,0 +1,9 @@
+from .python_ref import NeighborList, neighbor_list_brute, neighbor_list_numpy
+from .native import neighbor_list
+
+__all__ = [
+    "NeighborList",
+    "neighbor_list",
+    "neighbor_list_brute",
+    "neighbor_list_numpy",
+]

@@ -1,0 +1,129 @@
+"""ctypes bindings for the native (C++/OpenMP) neighbor search + partitioner.
+
+The shared library is built on demand from ``src/`` with ``make`` (g++,
+-O3 -march=native -fopenmp). If the build or load fails, callers fall back
+to the numpy implementations — same results, slower host path.
+
+No pybind11 in this image, so the ABI is a plain C handle API consumed via
+ctypes (see src/neighbor.cpp).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from .python_ref import NeighborList, neighbor_list_numpy
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_native.so")
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build_and_load():
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            srcs = [os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR) if f.endswith(".cpp")]
+            if not os.path.exists(_LIB_PATH) or any(
+                os.path.getmtime(s) > os.path.getmtime(_LIB_PATH) for s in srcs
+            ):
+                subprocess.run(
+                    ["make", "-s", "-C", _SRC_DIR],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.dm_neighbor_build.restype = ctypes.c_void_p
+            lib.dm_neighbor_build.argtypes = [
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_double,
+                ctypes.c_double,
+                ctypes.c_double,
+                ctypes.c_int,
+            ]
+            lib.dm_neighbor_num_edges.restype = ctypes.c_int64
+            lib.dm_neighbor_num_edges.argtypes = [ctypes.c_void_p]
+            lib.dm_neighbor_copy.restype = None
+            lib.dm_neighbor_copy.argtypes = [ctypes.c_void_p] + [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.dm_neighbor_free.restype = None
+            lib.dm_neighbor_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:
+            _load_failed = True
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def neighbor_list(
+    cart, lattice, pbc, r: float, bond_r: float = 0.0, tol: float = 1e-8,
+    num_threads: int | None = None,
+) -> NeighborList:
+    """Periodic neighbor search — native fast path with numpy fallback.
+
+    Thread count resolution mirrors the reference knob
+    (``DISTMLIP_NUM_THREADS`` env, default 8 — reference pes.py:65-66).
+    """
+    lib = _build_and_load()
+    if lib is None or np.asarray(cart).shape[0] == 0:
+        return neighbor_list_numpy(cart, lattice, pbc, r, bond_r, tol)
+    if num_threads is None:
+        num_threads = int(os.environ.get("DISTMLIP_TPU_NUM_THREADS",
+                                         os.environ.get("DISTMLIP_NUM_THREADS", 0)))
+    cart = np.ascontiguousarray(cart, dtype=np.float64)
+    lattice = np.ascontiguousarray(lattice, dtype=np.float64)
+    pbc_arr = np.ascontiguousarray(np.asarray(pbc, dtype=np.int64))
+    n = cart.shape[0]
+    handle = lib.dm_neighbor_build(
+        n, _ptr(cart, ctypes.c_double), _ptr(lattice, ctypes.c_double),
+        _ptr(pbc_arr, ctypes.c_int64), float(r), float(bond_r), float(tol),
+        int(num_threads),
+    )
+    if not handle:
+        raise RuntimeError("native neighbor search failed (empty system or r<=0)")
+    try:
+        ne = lib.dm_neighbor_num_edges(handle)
+        src = np.empty(ne, dtype=np.int64)
+        dst = np.empty(ne, dtype=np.int64)
+        offsets = np.empty((ne, 3), dtype=np.int32)
+        distances = np.empty(ne, dtype=np.float64)
+        bond_mask = np.empty(ne, dtype=np.uint8)
+        wrapped = np.empty((n, 3), dtype=np.float64)
+        shift = np.empty((n, 3), dtype=np.int64)
+        lib.dm_neighbor_copy(
+            handle, _ptr(src, ctypes.c_int64), _ptr(dst, ctypes.c_int64),
+            _ptr(offsets, ctypes.c_int32), _ptr(distances, ctypes.c_double),
+            _ptr(bond_mask, ctypes.c_uint8), _ptr(wrapped, ctypes.c_double),
+            _ptr(shift, ctypes.c_int64),
+        )
+    finally:
+        lib.dm_neighbor_free(handle)
+    return NeighborList(src, dst, offsets, distances, bond_mask.astype(bool), wrapped, shift)

@@ -1,0 +1,3 @@
+// Native partitioner (C++/OpenMP) — fast path mirroring
+// distmlip_tpu/partition/partitioner.py. Implementation lands after the
+// numpy oracle is locked in by the test suite.

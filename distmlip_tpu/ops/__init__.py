@@ -1,0 +1,3 @@
+from .segment import masked_segment_sum, masked_segment_mean, masked_segment_softmax
+
+__all__ = ["masked_segment_sum", "masked_segment_mean", "masked_segment_softmax"]

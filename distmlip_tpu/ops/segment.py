@@ -1,0 +1,40 @@
+"""Masked segment reductions for padded graphs.
+
+All graph aggregation in the framework goes through these: messages on
+padded (invalid) edges are zeroed by the mask and scattered to row 0, so
+static-shape padding never corrupts results.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_segment_sum(data, segment_ids, num_segments: int, mask=None):
+    """segment_sum with an optional validity mask on the data rows."""
+    if mask is not None:
+        m = mask.astype(data.dtype)
+        data = data * m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def masked_segment_mean(data, segment_ids, num_segments: int, mask=None, eps=1e-12):
+    tot = masked_segment_sum(data, segment_ids, num_segments, mask)
+    ones = jnp.ones(data.shape[0], dtype=data.dtype)
+    cnt = masked_segment_sum(ones, segment_ids, num_segments, mask)
+    return tot / jnp.maximum(cnt, eps).reshape(cnt.shape + (1,) * (tot.ndim - cnt.ndim))
+
+
+def masked_segment_softmax(logits, segment_ids, num_segments: int, mask=None):
+    """Numerically stable segment softmax over masked edges."""
+    neg = jnp.finfo(logits.dtype).min
+    if mask is not None:
+        logits = jnp.where(mask, logits, neg)
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
+    logits = logits - seg_max[segment_ids]
+    ex = jnp.exp(logits)
+    if mask is not None:
+        ex = jnp.where(mask, ex, 0.0)
+    denom = jax.ops.segment_sum(ex, segment_ids, num_segments=num_segments)
+    return ex / jnp.maximum(denom[segment_ids], 1e-30)

@@ -1,0 +1,13 @@
+from .mesh import GRAPH_AXIS, graph_mesh
+from .halo import LocalGraph, local_graph_from_stacked
+from .runtime import make_total_energy, make_potential_fn, graph_in_specs
+
+__all__ = [
+    "GRAPH_AXIS",
+    "graph_mesh",
+    "LocalGraph",
+    "local_graph_from_stacked",
+    "make_total_energy",
+    "make_potential_fn",
+    "graph_in_specs",
+]

@@ -1,0 +1,169 @@
+"""Halo exchange and the LocalGraph shard view.
+
+The halo exchange is the TPU-native replacement of the reference's in-place
+cross-GPU slice copies (reference dist.py:323-358): inside ``shard_map``,
+each partition gathers its "to_q" rows into a fixed-capacity payload, rotates
+it around the ring with ``jax.lax.ppermute`` (ICI neighbor traffic for slab
+decompositions), and scatters the received payload into its "from" slots.
+Padded recv indices point one past the array end, so XLA's
+drop-out-of-bounds scatter discards them. ``jax.grad`` transposes the
+ppermute automatically, which is exactly the reverse force flow the reference
+gets from torch autograd through device copies (reference pes.py:121-124).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _exchange(feats, send_idx, send_mask, recv_idx, shifts, axis_name):
+    """One round of halo exchange on a local feature array (N_cap, ...)."""
+    if not shifts or axis_name is None:
+        return feats
+    n_dev = lax.axis_size(axis_name)
+    for si, shift in enumerate(shifts):
+        idx = send_idx[si]
+        mask = send_mask[si]
+        payload = feats[idx]
+        m = mask.astype(feats.dtype).reshape(mask.shape + (1,) * (feats.ndim - 1))
+        payload = payload * m
+        perm = [(p, (p + shift) % n_dev) for p in range(n_dev)]
+        received = lax.ppermute(payload, axis_name, perm)
+        feats = feats.at[recv_idx[si]].set(received, mode="drop")
+    return feats
+
+
+@dataclass
+class LocalGraph:
+    """Per-shard view of a PartitionedGraph (leading P axis squeezed away).
+
+    Passed to model functions inside ``shard_map``; carries the local edge
+    lists, masks, halo tables, and the collective axis name. Models call the
+    methods below instead of touching collectives directly.
+    """
+
+    axis_name: str | None
+    shifts: tuple
+    n_cap: int
+    e_cap: int
+    b_cap: int
+    species: Any
+    node_mask: Any
+    owned_mask: Any
+    edge_src: Any
+    edge_dst: Any
+    edge_offset: Any
+    edge_mask: Any
+    halo_send_idx: Any
+    halo_send_mask: Any
+    halo_recv_idx: Any
+    lattice: Any
+    # bond graph
+    has_bond_graph: bool = False
+    line_src: Any = None
+    line_dst: Any = None
+    line_mask: Any = None
+    line_center: Any = None
+    bond_map_edge: Any = None
+    bond_map_bond: Any = None
+    bond_map_mask: Any = None
+    bond_halo_send_idx: Any = None
+    bond_halo_send_mask: Any = None
+    bond_halo_recv_idx: Any = None
+
+    # ---- collectives ----
+    def halo_exchange(self, feats):
+        """Refresh halo (from-section) rows of a node feature array."""
+        return _exchange(
+            feats, self.halo_send_idx, self.halo_send_mask, self.halo_recv_idx,
+            self.shifts, self.axis_name,
+        )
+
+    def bond_halo_exchange(self, feats):
+        """Refresh halo rows of a bond-node feature array."""
+        if not self.has_bond_graph:
+            return feats
+        return _exchange(
+            feats, self.bond_halo_send_idx, self.bond_halo_send_mask,
+            self.bond_halo_recv_idx, self.shifts, self.axis_name,
+        )
+
+    def psum(self, x):
+        if self.axis_name is None:
+            return x
+        return lax.psum(x, self.axis_name)
+
+    # ---- geometry ----
+    def edge_vectors(self, positions, lattice=None):
+        """(E_cap, 3) displacement vectors dst - src + offsets @ lattice."""
+        lat = self.lattice if lattice is None else lattice
+        disp = positions[self.edge_dst] - positions[self.edge_src]
+        return disp + self.edge_offset.astype(positions.dtype) @ lat
+
+    # ---- bond-graph index remaps (reference dist.py:635-702 analogue) ----
+    def edge_to_bond(self, edge_feats, bond_feats):
+        """Seed owned bond-node rows from their atom-graph edge features."""
+        vals = edge_feats[self.bond_map_edge]
+        m = self.bond_map_mask
+        vals = vals * m.astype(vals.dtype).reshape(m.shape + (1,) * (vals.ndim - 1))
+        idx = jnp.where(m, self.bond_map_bond, self.b_cap)
+        return bond_feats.at[idx].set(vals, mode="drop")
+
+    def bond_to_edge(self, bond_feats, edge_feats):
+        """Write owned bond-node features back onto their edges."""
+        vals = bond_feats[self.bond_map_bond]
+        m = self.bond_map_mask
+        vals = vals * m.astype(vals.dtype).reshape(m.shape + (1,) * (vals.ndim - 1))
+        idx = jnp.where(m, self.bond_map_edge, self.e_cap)
+        return edge_feats.at[idx].set(vals, mode="drop")
+
+    # ---- reductions ----
+    def owned_sum(self, per_atom):
+        """Sum a per-atom quantity over owned nodes, reduced across the mesh."""
+        m = self.owned_mask.astype(per_atom.dtype)
+        local = jnp.sum(per_atom * m.reshape(m.shape + (1,) * (per_atom.ndim - 1)))
+        return self.psum(local)
+
+
+def local_graph_from_stacked(g, axis_name: str | None) -> tuple[LocalGraph, Any]:
+    """Build a LocalGraph from shard-local (1, ...) slices of a PartitionedGraph.
+
+    Returns (local_graph, positions_local) where positions keep their leading
+    1-axis squeezed.
+    """
+    sq = lambda a: a[0] if a is not None and hasattr(a, "shape") and a.ndim >= 1 else a
+    lg = LocalGraph(
+        axis_name=axis_name,
+        shifts=g.shifts,
+        n_cap=g.n_cap,
+        e_cap=g.e_cap,
+        b_cap=g.b_cap,
+        species=sq(g.species),
+        node_mask=sq(g.node_mask),
+        owned_mask=sq(g.owned_mask),
+        edge_src=sq(g.edge_src),
+        edge_dst=sq(g.edge_dst),
+        edge_offset=sq(g.edge_offset),
+        edge_mask=sq(g.edge_mask),
+        halo_send_idx=g.halo_send_idx[:, 0],
+        halo_send_mask=g.halo_send_mask[:, 0],
+        halo_recv_idx=g.halo_recv_idx[:, 0],
+        lattice=g.lattice,
+        has_bond_graph=g.has_bond_graph,
+        line_src=sq(g.line_src),
+        line_dst=sq(g.line_dst),
+        line_mask=sq(g.line_mask),
+        line_center=sq(g.line_center),
+        bond_map_edge=sq(g.bond_map_edge),
+        bond_map_bond=sq(g.bond_map_bond),
+        bond_map_mask=sq(g.bond_map_mask),
+        bond_halo_send_idx=g.bond_halo_send_idx[:, 0],
+        bond_halo_send_mask=g.bond_halo_send_mask[:, 0],
+        bond_halo_recv_idx=g.bond_halo_recv_idx[:, 0],
+    )
+    return lg, sq(g.positions)

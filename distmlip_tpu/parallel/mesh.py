@@ -1,0 +1,26 @@
+"""Device-mesh helpers for graph parallelism.
+
+The framework runs graph-parallel over a 1-D mesh axis named ``"gp"``
+(slab i lives on device i). Multi-host meshes work unchanged: ``jax.devices()``
+spans hosts and slab adjacency maps onto ICI/DCN neighbor links.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+GRAPH_AXIS = "gp"
+
+
+def graph_mesh(num_partitions: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh of ``num_partitions`` devices for graph parallelism."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_partitions is None:
+        num_partitions = len(devices)
+    if num_partitions > len(devices):
+        raise ValueError(
+            f"Requested {num_partitions} partitions but only {len(devices)} devices."
+        )
+    return Mesh(np.array(devices[:num_partitions]), (GRAPH_AXIS,))

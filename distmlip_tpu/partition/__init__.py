@@ -1,0 +1,15 @@
+from .plan import PartitionPlan
+from .partitioner import build_plan, PartitionError
+from .graph import PartitionedGraph, HostGraphData, build_partitioned_graph
+from .capacity import CapacityPolicy, round_capacity
+
+__all__ = [
+    "PartitionPlan",
+    "build_plan",
+    "PartitionError",
+    "PartitionedGraph",
+    "HostGraphData",
+    "build_partitioned_graph",
+    "CapacityPolicy",
+    "round_capacity",
+]

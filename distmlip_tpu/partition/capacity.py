@@ -1,0 +1,33 @@
+"""Capacity bucketing: bound XLA recompiles under changing graph sizes.
+
+Edge/halo counts change every MD step; XLA programs need static shapes. We
+round every capacity up to a bucket so a recompile only happens when a count
+outgrows its bucket (the reference never faced this — eager PyTorch —
+see SURVEY.md §7 "Hard parts").
+"""
+
+from __future__ import annotations
+
+
+def round_capacity(n: int, slack: float = 1.2, multiple: int = 128) -> int:
+    """Round ``n * slack`` up to a multiple (default 128 = TPU lane width)."""
+    if n <= 0:
+        return multiple
+    target = int(n * slack) + 1
+    return ((target + multiple - 1) // multiple) * multiple
+
+
+class CapacityPolicy:
+    """Sticky capacities: grow in buckets, never shrink (per process)."""
+
+    def __init__(self, slack: float = 1.2, multiple: int = 128):
+        self.slack = slack
+        self.multiple = multiple
+        self._caps: dict[str, int] = {}
+
+    def get(self, name: str, needed: int) -> int:
+        cap = self._caps.get(name, 0)
+        if needed > cap:
+            cap = round_capacity(needed, self.slack, self.multiple)
+            self._caps[name] = cap
+        return cap

@@ -1,0 +1,99 @@
+"""Host-side partition plan: the output of the spatial graph partitioner.
+
+A ``PartitionPlan`` holds, per partition, numpy arrays describing the local
+node/edge/bond-graph layout. It is later padded to static capacities and
+stacked into a ``PartitionedGraph`` (device pytree) by
+``distmlip_tpu.partition.graph``.
+
+Layout convention (same idea as the reference's global-id arrays + markers,
+reference subgraph_creation_utils.c:1102-1154, dist.py:44-51, but cleaned up —
+markers here are plain cumulative-count vectors of length 2P+2):
+
+  local node order = [ pure | to_0 .. to_{P-1} | from_0 .. from_{P-1} ]
+
+  node_markers[p] = [0, n_pure, .. cumulative .., n_total]
+    - owned nodes  = locals [0, owned_count)   (pure + all to-sections)
+    - halo nodes   = locals [owned_count, total)
+
+The same layout is used for bond-graph nodes (directed edges within the bond
+cutoff promoted to line-graph nodes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class PartitionPlan:
+    num_partitions: int
+    axis: int                       # slab axis (index into lattice rows)
+    walls: np.ndarray               # (P-1,) fractional wall positions
+    node_part: np.ndarray           # (N,) owner partition of each global node
+    nodes_to_partition: np.ndarray  # (N,) partition a border node is sent to, else -1
+
+    # per-partition node layout
+    global_ids: list = field(default_factory=list)     # [p] -> (n_p,) local->global
+    node_markers: list = field(default_factory=list)   # [p] -> (2P+2,) cumulative
+    g2l: list = field(default_factory=list)            # [p] -> (N,) global->local or -1
+
+    # per-partition edges (owner-computes: edge lives with its dst's owner)
+    edge_ids: list = field(default_factory=list)       # [p] -> (E_p,) global edge ids
+    src_local: list = field(default_factory=list)
+    dst_local: list = field(default_factory=list)
+    edge_offsets: list = field(default_factory=list)   # [p] -> (E_p, 3) int32
+
+    # bond graph (optional)
+    has_bond_graph: bool = False
+    bond_markers: list = field(default_factory=list)       # [p] -> (2P+2,)
+    bond_global_edge: list = field(default_factory=list)   # [p] -> (B_p,) global DE id per bond node
+    bond_needs_in_line: list = field(default_factory=list) # [p] -> (B_p,) bool
+    line_src: list = field(default_factory=list)           # [p] -> (L_p,) local bond ids
+    line_dst: list = field(default_factory=list)
+    line_center_local: list = field(default_factory=list)  # [p] -> (L_p,) local atom ids
+    bond_mapping_edge: list = field(default_factory=list)  # [p] -> (M_p,) local edge ids
+    bond_mapping_bond: list = field(default_factory=list)  # [p] -> (M_p,) local bond ids
+
+    @property
+    def owned_counts(self) -> np.ndarray:
+        """Number of owned (pure + to) nodes per partition."""
+        P = self.num_partitions
+        return np.array([m[1 + P] for m in self.node_markers])
+
+    def section(self, p: int, kind: str, q: int) -> tuple[int, int]:
+        """Local index range of a section: kind in {'to','from'}, peer q."""
+        P = self.num_partitions
+        m = self.node_markers[p]
+        if kind == "to":
+            return int(m[1 + q]), int(m[2 + q])
+        elif kind == "from":
+            return int(m[1 + P + q]), int(m[2 + P + q])
+        raise ValueError(kind)
+
+    def bond_section(self, p: int, kind: str, q: int) -> tuple[int, int]:
+        P = self.num_partitions
+        m = self.bond_markers[p]
+        if kind == "to":
+            return int(m[1 + q]), int(m[2 + q])
+        elif kind == "from":
+            return int(m[1 + P + q]), int(m[2 + P + q])
+        raise ValueError(kind)
+
+    def summary(self) -> str:
+        """Partition-balance report (reference dist.py:704-721 analogue)."""
+        P = self.num_partitions
+        lines = [f"PartitionPlan(P={P}, axis={self.axis})"]
+        for p in range(P):
+            m = self.node_markers[p]
+            owned = m[1 + P]
+            halo = m[-1] - owned
+            ne = len(self.edge_ids[p]) if self.edge_ids else 0
+            extra = ""
+            if self.has_bond_graph:
+                extra = f", bonds={self.bond_markers[p][-1]}, lines={len(self.line_src[p])}"
+            lines.append(
+                f"  partition {p}: owned={owned} (pure={m[1]}), halo={halo}, edges={ne}{extra}"
+            )
+        return "\n".join(lines)

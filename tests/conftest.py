@@ -1,0 +1,38 @@
+"""Test configuration: run JAX on 8 virtual CPU devices.
+
+The JAX analogue of the reference exercising multi-GPU paths with "cpu"
+device strings (reference chgnet.py:465-469): an 8-device host-platform
+mesh lets every multi-partition code path (shard_map, ppermute halo
+exchange) execute for real without TPU hardware.
+
+Note: this image auto-registers the 'axon' TPU platform via sitecustomize
+and ignores JAX_PLATFORMS, so we force CPU through jax.config instead.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+def random_cell(rng, n_atoms=32, box=8.0, jitter=0.0, n_species=3):
+    """A random periodic test cell: slightly non-orthorhombic box."""
+    lattice = np.eye(3) * box
+    lattice[0, 1] = 0.1 * box * jitter
+    frac = rng.random((n_atoms, 3))
+    cart = frac @ lattice
+    species = rng.integers(0, n_species, n_atoms).astype(np.int32)
+    pbc = np.array([1, 1, 1])
+    return cart, lattice, species, pbc
+
+
+@pytest.fixture
+def small_cell(rng):
+    return random_cell(rng, n_atoms=40, box=9.0)

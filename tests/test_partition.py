@@ -1,0 +1,151 @@
+"""Partitioner invariants: disjoint cover, edge conservation, halo alignment,
+line-graph equivalence vs a brute-force global line graph."""
+
+import numpy as np
+import pytest
+
+from distmlip_tpu.neighbors import neighbor_list_numpy
+from distmlip_tpu.partition import PartitionError, build_plan
+from tests.conftest import random_cell
+
+R = 3.0
+BOND_R = 2.0
+
+
+def make_plan(rng, P, n_atoms=None, box=None, bond=False):
+    # slab width must exceed 2*R for the one-destination halo invariant
+    box = box or max(16.0, P * 8.0)
+    n_atoms = n_atoms or int(0.02 * box**3)
+    cart, lattice, species, pbc = random_cell(rng, n_atoms=n_atoms, box=box)
+    nl = neighbor_list_numpy(cart, lattice, pbc, R, bond_r=BOND_R)
+    plan = build_plan(nl, lattice, pbc, P, R, BOND_R, use_bond_graph=bond)
+    return plan, nl, lattice
+
+
+@pytest.mark.parametrize("P", [1, 2, 4])
+def test_owned_disjoint_cover(rng, P):
+    plan, nl, _ = make_plan(rng, P)
+    n = nl.wrapped_cart.shape[0]
+    seen = np.zeros(n, dtype=int)
+    for p in range(P):
+        owned = plan.global_ids[p][: plan.owned_counts[p]]
+        seen[owned] += 1
+    np.testing.assert_array_equal(seen, np.ones(n, dtype=int))
+
+
+@pytest.mark.parametrize("P", [1, 2, 4])
+def test_edge_conservation(rng, P):
+    plan, nl, _ = make_plan(rng, P)
+    all_ids = np.concatenate([plan.edge_ids[p] for p in range(P)])
+    assert len(all_ids) == nl.num_edges
+    np.testing.assert_array_equal(np.sort(all_ids), np.arange(nl.num_edges))
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_edge_localization(rng, P):
+    """Local endpoints must map back to the correct global endpoints."""
+    plan, nl, _ = make_plan(rng, P)
+    for p in range(P):
+        g = plan.global_ids[p]
+        np.testing.assert_array_equal(g[plan.src_local[p]], nl.src[plan.edge_ids[p]])
+        np.testing.assert_array_equal(g[plan.dst_local[p]], nl.dst[plan.edge_ids[p]])
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_halo_alignment(rng, P):
+    """to_q section of p and from_p section of q hold the same global ids in
+    the same order — the exchange is then a pure slot copy."""
+    plan, _, _ = make_plan(rng, P)
+    for p in range(P):
+        for q in range(P):
+            if p == q:
+                continue
+            ts, te = plan.section(p, "to", q)
+            fs, fe = plan.section(q, "from", p)
+            np.testing.assert_array_equal(
+                plan.global_ids[p][ts:te], plan.global_ids[q][fs:fe]
+            )
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_border_reach(rng, P):
+    """Every cross-partition edge's src is present in the dst's partition."""
+    plan, nl, _ = make_plan(rng, P)
+    for p in range(P):
+        assert np.all(plan.g2l[p][nl.src[plan.edge_ids[p]]] >= 0)
+
+
+def test_too_many_partitions_raises(rng):
+    cart, lattice, _, pbc = random_cell(rng, n_atoms=60, box=10.0)
+    nl = neighbor_list_numpy(cart, lattice, pbc, R)
+    with pytest.raises(PartitionError):
+        build_plan(nl, lattice, pbc, 8, R)
+
+
+def _global_line_graph(nl):
+    """Brute-force directed line graph over within-bond edges.
+
+    (e1=(s->d), e2=(d->k)) with k != s; returns the set of global edge-id
+    pairs plus the center atom d.
+    """
+    W = np.nonzero(nl.bond_mask)[0]
+    pairs = set()
+    by_src = {}
+    for e in W:
+        by_src.setdefault(int(nl.src[e]), []).append(e)
+    for e1 in W:
+        d = int(nl.dst[e1])
+        for e2 in by_src.get(d, []):
+            if int(nl.dst[e2]) == int(nl.src[e1]):
+                continue
+            pairs.add((int(e1), int(e2), d))
+    return pairs
+
+
+@pytest.mark.parametrize("P", [1, 2, 4])
+def test_line_graph_equivalence(rng, P):
+    plan, nl, _ = make_plan(rng, P, bond=True)
+    got = set()
+    for p in range(P):
+        b_edge = plan.bond_global_edge[p]
+        g = plan.global_ids[p]
+        for ls, ld, c in zip(plan.line_src[p], plan.line_dst[p], plan.line_center_local[p]):
+            got.add((int(b_edge[ls]), int(b_edge[ld]), int(g[c])))
+    want = _global_line_graph(nl)
+    assert got == want
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_line_graph_no_duplicates(rng, P):
+    plan, _, _ = make_plan(rng, P, bond=True)
+    total, uniq = 0, set()
+    for p in range(P):
+        b_edge = plan.bond_global_edge[p]
+        for ls, ld in zip(plan.line_src[p], plan.line_dst[p]):
+            uniq.add((int(b_edge[ls]), int(b_edge[ld])))
+            total += 1
+    assert total == len(uniq)
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_bond_halo_alignment(rng, P):
+    plan, _, _ = make_plan(rng, P, bond=True)
+    for p in range(P):
+        for q in range(P):
+            if p == q:
+                continue
+            ts, te = plan.bond_section(p, "to", q)
+            fs, fe = plan.bond_section(q, "from", p)
+            np.testing.assert_array_equal(
+                plan.bond_global_edge[p][ts:te], plan.bond_global_edge[q][fs:fe]
+            )
+
+
+@pytest.mark.parametrize("P", [1, 2, 4])
+def test_bond_mapping(rng, P):
+    """Owned bond nodes map to local edges carrying the same global edge."""
+    plan, nl, _ = make_plan(rng, P, bond=True)
+    for p in range(P):
+        local_edge_global = plan.edge_ids[p][plan.bond_mapping_edge[p]]
+        bond_global = plan.bond_global_edge[p][plan.bond_mapping_bond[p]]
+        np.testing.assert_array_equal(local_edge_global, bond_global)

@@ -1,0 +1,81 @@
+"""Benchmark: MD-step throughput (atoms/sec) for the flagship model on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the full MD-step critical path — host neighbor search + partition
++ device energy/forces — steady-state (post-compile), matching the
+reference's per-step pipeline (reference pes.py:50-146 re-partitions every
+call). vs_baseline compares against BASELINE_LOCAL.json when present
+(reference numbers are not published in-repo, see BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    os.environ.setdefault("DISTMLIP_TPU_NUM_THREADS", str(os.cpu_count() or 8))
+    import jax
+
+    from distmlip_tpu import geometry
+    from distmlip_tpu.calculators import Atoms, DistPotential
+    from distmlip_tpu.models import CHGNet, CHGNetConfig
+
+    reps = int(os.environ.get("BENCH_REPS", "16"))
+    steps = int(os.environ.get("BENCH_STEPS", "5"))
+
+    # ~4*reps^3 atom perturbed Si-like crystal (16 -> 16384 atoms)
+    rng = np.random.default_rng(0)
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9, (reps, reps, reps))
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, 0.04, (len(frac), 3))
+    atoms = Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
+
+    cfg = CHGNetConfig(
+        num_species=95, units=64, num_rbf=9, num_angle=9, num_blocks=4,
+        cutoff=5.0, bond_cutoff=3.0,
+    )
+    model = CHGNet(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    pot = DistPotential(model, params, num_partitions=len(jax.devices()),
+                        compute_stress=True)
+
+    # warmup (compile)
+    pot.calculate(atoms)
+    # steady state: perturb positions each step like MD
+    times = []
+    for _ in range(steps):
+        atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
+        t0 = time.perf_counter()
+        res = pot.calculate(atoms)
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    atoms_per_sec = len(atoms) / dt
+
+    vs = 0.0
+    base_path = os.path.join(os.path.dirname(__file__), "BASELINE_LOCAL.json")
+    if os.path.exists(base_path):
+        base = json.load(open(base_path))
+        ref = base.get("chgnet_md_atoms_per_sec")
+        if ref:
+            vs = atoms_per_sec / ref
+
+    print(json.dumps({
+        "metric": "chgnet_16k_md_step_atoms_per_sec_per_chip",
+        "value": round(atoms_per_sec, 1),
+        "unit": "atoms/s",
+        "vs_baseline": round(vs, 3),
+    }))
+    print(f"# n_atoms={len(atoms)} step={dt*1e3:.1f}ms "
+          f"(nl={pot.last_timings['neighbor_s']*1e3:.1f}ms "
+          f"part={pot.last_timings['partition_s']*1e3:.1f}ms "
+          f"dev={pot.last_timings['device_s']*1e3:.1f}ms) "
+          f"devices={jax.devices()}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Structure relaxation: FIRE optimizer with optional cell relaxation.
+
+Reference analogue: the Relaxer with ASE FIRE/BFGS + Frechet/Exp cell
+filters (reference implementations/matgl/ase.py:130-223). Here FIRE runs
+over a combined (positions, strain) degree-of-freedom vector — the strain
+block plays the role of ASE's cell filters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .atoms import EV_A3_TO_GPA, Atoms
+
+
+@dataclass
+class RelaxResult:
+    atoms: Atoms
+    converged: bool
+    nsteps: int
+    energy: float
+    forces: np.ndarray
+    stress: np.ndarray
+    trajectory: list = field(default_factory=list)
+
+
+class Relaxer:
+    def __init__(
+        self,
+        potential,
+        relax_cell: bool = False,
+        fmax: float = 0.05,          # eV/Å
+        smax: float = 0.005,         # eV/Å^3 (cell gradient tolerance)
+        dt_start: float = 0.1,
+        dt_max: float = 1.0,
+        n_min: int = 5,
+        f_inc: float = 1.1,
+        f_dec: float = 0.5,
+        alpha_start: float = 0.1,
+        f_alpha: float = 0.99,
+        cell_factor: float | None = None,  # None -> len(atoms), balances cell vs position DOFs
+    ):
+        self.potential = potential
+        self.relax_cell = relax_cell
+        self.fmax = fmax
+        self.smax = smax
+        self.dt_start, self.dt_max = dt_start, dt_max
+        self.n_min, self.f_inc, self.f_dec = n_min, f_inc, f_dec
+        self.alpha_start, self.f_alpha = alpha_start, f_alpha
+        self.cell_factor = cell_factor
+
+    def relax(self, atoms: Atoms, steps: int = 500, record: bool = False) -> RelaxResult:
+        atoms = atoms.copy()
+        n = len(atoms)
+        cell_factor = self.cell_factor if self.cell_factor is not None else max(n, 1)
+        v = np.zeros((n + 3, 3))
+        dt = self.dt_start
+        alpha = self.alpha_start
+        n_pos = 0
+        traj = []
+        res = self.potential.calculate(atoms)
+        converged = False
+        it = 0
+        for it in range(1, steps + 1):
+            # generalized gradient: forces block + cell block (-V * stress)
+            g = np.zeros((n + 3, 3))
+            g[:n] = res["forces"]
+            if self.relax_cell:
+                g[n:] = -atoms.volume * res["stress"] / cell_factor
+            f_norm = np.abs(g[:n]).max() if n else 0.0
+            s_norm = np.abs(res["stress"]).max() if self.relax_cell else 0.0
+            if record:
+                traj.append(
+                    {"energy": res["energy"], "fmax": f_norm, "cell": atoms.cell.copy()}
+                )
+            if f_norm < self.fmax and (not self.relax_cell or s_norm < self.smax):
+                converged = True
+                break
+
+            # FIRE velocity mixing
+            p = float(np.vdot(g, v))
+            if p > 0:
+                n_pos += 1
+                if n_pos > self.n_min:
+                    dt = min(dt * self.f_inc, self.dt_max)
+                    alpha *= self.f_alpha
+            else:
+                n_pos = 0
+                dt *= self.f_dec
+                alpha = self.alpha_start
+                v[:] = 0.0
+            v += dt * g
+            gn = np.linalg.norm(g) + 1e-12
+            vn = np.linalg.norm(v)
+            v = (1 - alpha) * v + alpha * g / gn * vn
+
+            step_vec = dt * v
+            max_step = np.abs(step_vec).max()
+            if max_step > 0.2:  # trust radius
+                step_vec *= 0.2 / max_step
+            atoms.positions += step_vec[:n]
+            if self.relax_cell:
+                strain = step_vec[n:] / max(atoms.volume, 1.0) * cell_factor
+                defm = np.eye(3) + 0.5 * (strain + strain.T)
+                atoms.cell = atoms.cell @ defm
+                atoms.positions = atoms.positions @ defm
+            res = self.potential.calculate(atoms)
+
+        return RelaxResult(
+            atoms=atoms, converged=converged, nsteps=it, energy=res["energy"],
+            forces=res["forces"], stress=res["stress"], trajectory=traj,
+        )

@@ -1,0 +1,9 @@
+from .pair import PairPotential, PairConfig
+from .tensornet import TensorNet, TensorNetConfig
+from .chgnet import CHGNet, CHGNetConfig
+
+__all__ = [
+    "PairPotential", "PairConfig",
+    "TensorNet", "TensorNetConfig",
+    "CHGNet", "CHGNetConfig",
+]

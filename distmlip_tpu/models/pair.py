@@ -1,0 +1,45 @@
+"""Simple analytic pair potentials (Lennard-Jones, Morse).
+
+Useful as fast baselines, MD integrator test oracles, and runtime
+smoke-tests — and as the minimal example of the model contract:
+``energy_fn(params, lg, positions) -> per-atom energies``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..ops import radial
+from ..ops.segment import masked_segment_sum
+
+
+@dataclass(frozen=True)
+class PairConfig:
+    cutoff: float = 5.0
+    kind: str = "lj"  # "lj" | "morse"
+
+
+class PairPotential:
+    def __init__(self, config: PairConfig = PairConfig()):
+        self.cfg = config
+
+    def init(self, key=None) -> dict:
+        if self.cfg.kind == "lj":
+            return {"eps": jnp.float32(1.0), "sigma": jnp.float32(2.2)}
+        return {"D": jnp.float32(1.0), "a": jnp.float32(1.5), "r0": jnp.float32(2.2)}
+
+    def energy_fn(self, params, lg, positions):
+        vec = lg.edge_vectors(positions)
+        d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
+        env = radial.cosine_cutoff(d, self.cfg.cutoff)
+        if self.cfg.kind == "lj":
+            x = (params["sigma"] / d) ** 6
+            e_edge = 4.0 * params["eps"] * (x * x - x)
+        else:
+            ex = jnp.exp(-params["a"] * (d - params["r0"]))
+            e_edge = params["D"] * (ex * ex - 2.0 * ex)
+        e_edge = jnp.where(lg.edge_mask, e_edge * env, 0.0)
+        # half: every pair appears as two directed edges
+        return 0.5 * masked_segment_sum(e_edge[:, None], lg.edge_dst, lg.n_cap)[:, 0]

@@ -1,0 +1,215 @@
+"""TensorNet: O(3)-equivariant message passing on rank-2 tensor features.
+
+A TPU-native implementation of the TensorNet architecture (Simeon & De
+Fabritiis 2023) as deployed for MLIPs by matgl, matching the capability the
+reference wraps in its distributed TensorNet path (reference
+implementations/matgl/models/tensornet.py:10-161: per-partition interaction
+layers with an atom-feature halo exchange after each, then an invariant
+readout). Here each node carries X_i in R^{C x 3 x 3}; messages scale the
+neighbor tensor's irreducible components by radial weights; the update is a
+matrix polynomial — all dense (C,3,3) einsums that map straight onto the MXU.
+
+Distributed contract: edges live with their dst owner, so every in-edge of an
+owned node is local; after each layer the updated tensors of border nodes are
+refreshed on neighbors via ``lg.halo_exchange`` (one call per layer — same
+cadence as the reference's ``atom_transfer``, tensornet.py:121-128).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import radial
+from ..ops.nn import (embedding, embedding_init, layernorm, layernorm_init,
+                      linear, linear_init, mlp, mlp_init)
+from ..ops.segment import masked_segment_sum
+
+
+@dataclass(frozen=True)
+class TensorNetConfig:
+    num_species: int = 95
+    units: int = 64
+    num_rbf: int = 32
+    num_layers: int = 2
+    cutoff: float = 5.0
+    dtype: str = "float32"
+
+
+def decompose(X):
+    """Split (..., 3, 3) into (trace-part I, antisymmetric A, sym-traceless S)."""
+    trace = jnp.trace(X, axis1=-2, axis2=-1)[..., None, None]
+    eye = jnp.eye(3, dtype=X.dtype)
+    I = trace / 3.0 * eye
+    A = 0.5 * (X - jnp.swapaxes(X, -1, -2))
+    S = 0.5 * (X + jnp.swapaxes(X, -1, -2)) - I
+    return I, A, S
+
+
+def tensor_norm(X):
+    """Per-channel squared Frobenius norm: (..., C, 3, 3) -> (..., C)."""
+    return jnp.sum(X * X, axis=(-2, -1))
+
+
+def tensor_rms_norm(X):
+    """Bounded-gain normalization: divide by (RMS of channel norms + 1).
+
+    Gain is <= 1 everywhere — vanishing features stay vanishing (no
+    1/sqrt(eps) amplification that would create spurious forces at the
+    cutoff), while O(1)+ features are normalized to O(1). Returns
+    (X_normalized, per-channel squared norms of X_normalized).
+    """
+    n = tensor_norm(X)
+    scale = 1.0 / (jnp.sqrt(jnp.mean(n, axis=-1, keepdims=True)) + 1.0)
+    Xn = X * scale[..., None, None]
+    return Xn, n * scale**2
+
+
+def magnitude_gate(n, c: float = 0.01):
+    """Smooth per-atom gate in [0,1): mean-norm / (mean-norm + c).
+
+    Multiplies LayerNorm-driven MLP outputs so they (and their position
+    gradients) vanish smoothly as an atom's features vanish — keeps the
+    isolated-atom / cutoff limit force-free instead of letting LayerNorm
+    amplify vanishing signals.
+    """
+    nbar = jnp.mean(n, axis=-1, keepdims=True)
+    return nbar / (nbar + c)
+
+
+def _vector_to_skew(v):
+    """(..., 3) -> (..., 3, 3) antisymmetric [v]_x."""
+    zero = jnp.zeros_like(v[..., 0])
+    rows = [
+        jnp.stack([zero, -v[..., 2], v[..., 1]], axis=-1),
+        jnp.stack([v[..., 2], zero, -v[..., 0]], axis=-1),
+        jnp.stack([-v[..., 1], v[..., 0], zero], axis=-1),
+    ]
+    return jnp.stack(rows, axis=-2)
+
+
+class TensorNet:
+    def __init__(self, config: TensorNetConfig = TensorNetConfig()):
+        self.cfg = config
+
+    # ---- parameters ----
+    def init(self, key) -> dict:
+        cfg = self.cfg
+        ks = iter(jax.random.split(key, 16 + 8 * cfg.num_layers))
+        C, R = cfg.units, cfg.num_rbf
+        params = {
+            "species_emb": embedding_init(next(ks), cfg.num_species, C),
+            "edge_embed": mlp_init(next(ks), [2 * C + R, C, 3 * C]),
+            "emb_norm_mlp": mlp_init(next(ks), [C, C, 3 * C]),
+            "emb_ln": layernorm_init(C),
+            "layers": [],
+            "readout": mlp_init(next(ks), [3 * C, C, 1]),
+            "readout_ln": layernorm_init(3 * C),
+            "species_ref": {"w": jnp.zeros((cfg.num_species, 1))},
+        }
+        for _ in range(cfg.num_layers):
+            params["layers"].append(
+                {
+                    "rbf_w": linear_init(next(ks), R, 3 * C),
+                    "norm_mlp": mlp_init(next(ks), [C, C, 3 * C]),
+                    "ln": layernorm_init(C),
+                    "mix_in": [linear_init(next(ks), C, C, bias=False) for _ in range(3)],
+                    "mix_out": [linear_init(next(ks), C, C, bias=False) for _ in range(3)],
+                }
+            )
+        return params
+
+    # ---- forward ----
+    def energy_fn(self, params, lg, positions):
+        cfg = self.cfg
+        C = cfg.units
+        vec = lg.edge_vectors(positions)
+        d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
+        rhat = vec / jnp.maximum(d, 1e-9)[:, None]
+        env = radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask
+        rbf = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_rbf)
+
+        eye = jnp.eye(3, dtype=positions.dtype)
+        A_e = _vector_to_skew(rhat)                       # (E, 3, 3)
+        S_e = rhat[:, :, None] * rhat[:, None, :] - eye / 3.0
+
+        # --- embedding: per-edge tensors weighted by species + radial ---
+        z = embedding(params["species_emb"], lg.species)  # (N, C)
+        ef = jnp.concatenate([z[lg.edge_src], z[lg.edge_dst], rbf], axis=-1)
+        w = mlp(params["edge_embed"], ef).reshape(-1, 3, C) * env[:, None, None]
+        comps = jnp.stack(
+            [jnp.broadcast_to(eye, A_e.shape), A_e, S_e], axis=1
+        )                                                 # (E, 3, 3, 3)
+        edge_X = jnp.einsum("ekc,ekij->ecij", w, comps)   # (E, C, 3, 3)
+        X = masked_segment_sum(edge_X, lg.edge_dst, lg.n_cap, lg.edge_mask)
+
+        X = self._normalize_mix(params["emb_norm_mlp"], X, params["emb_ln"])
+        X = lg.halo_exchange(X)
+
+        # --- interaction layers ---
+        for lp in params["layers"]:
+            X = self._interaction(lp, lg, X, rbf, env)
+            X = lg.halo_exchange(X)
+
+        # --- invariant readout ---
+        Xr, nr = tensor_rms_norm(X)
+        I, A, S = decompose(Xr)
+        inv = jnp.concatenate([tensor_norm(I), tensor_norm(A), tensor_norm(S)], axis=-1)
+        e_atom = mlp(params["readout"], layernorm(params["readout_ln"], inv))[:, 0]
+        e_atom = e_atom * magnitude_gate(nr)[..., 0]
+        e_ref = params["species_ref"]["w"][lg.species, 0]
+        return e_atom + e_ref
+
+    def _normalize_mix(self, norm_mlp, X, ln):
+        C = self.cfg.units
+        X, n = tensor_rms_norm(X)
+        s = mlp(norm_mlp, layernorm(ln, n)).reshape(n.shape[:-1] + (3, C))
+        s = s * magnitude_gate(n)[..., None]
+        I, A, S = decompose(X)
+        return (
+            s[..., 0, :, None, None] * I
+            + s[..., 1, :, None, None] * A
+            + s[..., 2, :, None, None] * S
+        )
+
+    def _mix_channels(self, lins, X):
+        """Per-component channel-mixing linear maps (C -> C)."""
+        I, A, S = decompose(X)
+        out = []
+        for lin, comp in zip(lins, (I, A, S)):
+            # (..., C, 3, 3) channel mix: contract channel axis
+            out.append(jnp.einsum("...cij,cd->...dij", comp, lin["w"]))
+        return out[0] + out[1] + out[2]
+
+    def _interaction(self, lp, lg, X, rbf, env):
+        C = self.cfg.units
+        # normalize + per-channel mix
+        Xn, _ = tensor_rms_norm(X)
+        Xm = self._mix_channels(lp["mix_in"], Xn)
+
+        # radial message weights per component/channel
+        f = linear(lp["rbf_w"], rbf).reshape(-1, 3, C) * env[:, None, None]
+        I_j, A_j, S_j = decompose(Xm[lg.edge_src])
+        M = (
+            f[:, 0, :, None, None] * I_j
+            + f[:, 1, :, None, None] * A_j
+            + f[:, 2, :, None, None] * S_j
+        )
+        Y = masked_segment_sum(M, lg.edge_dst, lg.n_cap, lg.edge_mask)
+
+        # matrix-polynomial node update
+        Y2 = jnp.einsum("...ij,...jk->...ik", Y, Y)
+        B = Y + Y2
+        Bn, bn = tensor_rms_norm(B)
+        s = mlp(lp["norm_mlp"], layernorm(lp["ln"], bn)).reshape(bn.shape[:-1] + (3, C))
+        s = s * magnitude_gate(bn)[..., None]
+        I_b, A_b, S_b = decompose(Bn)
+        dX = (
+            s[..., 0, :, None, None] * I_b
+            + s[..., 1, :, None, None] * A_b
+            + s[..., 2, :, None, None] * S_b
+        )
+        dX = self._mix_channels(lp["mix_out"], dX)
+        return X + dX

@@ -79,6 +79,12 @@ def native_available() -> bool:
     return _build_and_load() is not None
 
 
+def resolve_num_threads() -> int:
+    """Single source of truth for the host-thread knob (0 = all cores)."""
+    return int(os.environ.get("DISTMLIP_TPU_NUM_THREADS",
+                              os.environ.get("DISTMLIP_NUM_THREADS", 0)))
+
+
 def _ptr(arr, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
@@ -89,15 +95,15 @@ def neighbor_list(
 ) -> NeighborList:
     """Periodic neighbor search — native fast path with numpy fallback.
 
-    Thread count resolution mirrors the reference knob
-    (``DISTMLIP_NUM_THREADS`` env, default 8 — reference pes.py:65-66).
+    Threads resolve as: explicit arg > DISTMLIP_TPU_NUM_THREADS >
+    DISTMLIP_NUM_THREADS > 0 (= OpenMP default, all cores). The env-var knob
+    mirrors the reference (pes.py:65-66).
     """
     lib = _build_and_load()
     if lib is None or np.asarray(cart).shape[0] == 0:
         return neighbor_list_numpy(cart, lattice, pbc, r, bond_r, tol)
     if num_threads is None:
-        num_threads = int(os.environ.get("DISTMLIP_TPU_NUM_THREADS",
-                                         os.environ.get("DISTMLIP_NUM_THREADS", 0)))
+        num_threads = resolve_num_threads()
     cart = np.ascontiguousarray(cart, dtype=np.float64)
     lattice = np.ascontiguousarray(lattice, dtype=np.float64)
     pbc_arr = np.ascontiguousarray(np.asarray(pbc, dtype=np.int64))

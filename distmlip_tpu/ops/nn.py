@@ -1,0 +1,88 @@
+"""Minimal neural-net building blocks on plain parameter pytrees.
+
+Models in this framework are pure functions over nested-dict parameter
+pytrees (no flax dependency on the hot path): transparent for sharding,
+trivial to convert into from torch state dicts, and friendly to
+``jax.grad``/``optax``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def linear_init(key, d_in: int, d_out: int, bias: bool = True, scale: str = "torch"):
+    """Torch-style default init: W, b ~ U(-1/sqrt(d_in), 1/sqrt(d_in)).
+
+    Non-zero bias init matters: scale-producing MLPs fed with small inputs
+    must still emit O(1) outputs at init, or deep feature pipelines collapse.
+    """
+    wkey, bkey = jax.random.split(key)
+    if scale == "glorot":
+        lim = np.sqrt(6.0 / (d_in + d_out))
+        w = jax.random.uniform(wkey, (d_in, d_out), minval=-lim, maxval=lim)
+    else:
+        lim = 1.0 / np.sqrt(d_in)
+        w = jax.random.uniform(wkey, (d_in, d_out), minval=-lim, maxval=lim)
+    p = {"w": w}
+    if bias:
+        lim = 1.0 / np.sqrt(d_in)
+        p["b"] = jax.random.uniform(bkey, (d_out,), minval=-lim, maxval=lim)
+    return p
+
+
+def linear(p, x):
+    y = x @ p["w"]
+    if "b" in p:
+        y = y + p["b"]
+    return y
+
+
+def mlp_init(key, dims: list[int], bias: bool = True):
+    keys = jax.random.split(key, len(dims) - 1)
+    return [linear_init(k, a, b, bias=bias) for k, a, b in zip(keys, dims[:-1], dims[1:])]
+
+
+def mlp(p, x, act=jax.nn.silu, final_act=None):
+    for i, layer in enumerate(p):
+        x = linear(layer, x)
+        if i < len(p) - 1:
+            x = act(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x
+
+
+def gated_mlp_init(key, d_in: int, dims: list[int]):
+    """CHGNet-style gated MLP: core MLP * sigmoid(gate MLP)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "core": mlp_init(k1, [d_in] + dims),
+        "gate": mlp_init(k2, [d_in] + dims),
+    }
+
+
+def gated_mlp(p, x, act=jax.nn.silu):
+    core = mlp(p["core"], x, act=act, final_act=act)
+    gate = mlp(p["gate"], x, act=act, final_act=jax.nn.sigmoid)
+    return core * gate
+
+
+def layernorm_init(dim: int):
+    return {"g": jnp.ones((dim,)), "b": jnp.zeros((dim,))}
+
+
+def layernorm(p, x, eps: float = 1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * p["g"] + p["b"]
+
+
+def embedding_init(key, num: int, dim: int):
+    return {"w": jax.random.normal(key, (num, dim)) / np.sqrt(dim)}
+
+
+def embedding(p, idx):
+    return p["w"][idx]

@@ -1,0 +1,64 @@
+"""Radial basis functions and cutoff envelopes (pure JAX, jit/grad-safe).
+
+The bases used across the model zoo:
+  - GaussianExpansion     (CHGNet-style smeared distances)
+  - SphericalBesselBasis  (matgl TensorNet / MACE-style j0 Bessel basis)
+  - FourierExpansion      (CHGNet angle features)
+  - polynomial_cutoff     (MACE/CHGNet smooth envelope)
+  - cosine_cutoff         (Behler-style envelope)
+
+All functions are smooth at the cutoff so forces stay continuous.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def gaussian_expansion(d, centers, width):
+    """exp(-(d - c)^2 / width^2) for each center. d: (...,), -> (..., C)."""
+    c = jnp.asarray(centers, dtype=d.dtype)
+    return jnp.exp(-((d[..., None] - c) ** 2) / (width**2))
+
+
+def spherical_bessel_basis(d, cutoff: float, num_basis: int):
+    """Normalized j0 Bessel basis: sqrt(2/rc) * sin(n pi d / rc) / d.
+
+    Safe at d=0 (returns the n*pi/rc limit).
+    """
+    n = jnp.arange(1, num_basis + 1, dtype=d.dtype)
+    rc = jnp.asarray(cutoff, dtype=d.dtype)
+    x = d[..., None]
+    arg = n * jnp.pi * x / rc
+    small = x < 1e-8
+    safe_x = jnp.where(small, 1.0, x)
+    out = jnp.sqrt(2.0 / rc) * jnp.sin(arg) / safe_x
+    limit = jnp.sqrt(2.0 / rc) * n * jnp.pi / rc
+    return jnp.where(small, limit, out)
+
+
+def fourier_expansion(x, max_f: int, interval: float = np.pi):
+    """[1/sqrt(2), cos(n pi x / L), sin(n pi x / L)] for n=1..max_f.
+
+    x: (...,) -> (..., 2*max_f + 1). CHGNet's angle basis over x = theta.
+    """
+    n = jnp.arange(1, max_f + 1, dtype=x.dtype)
+    arg = x[..., None] * n * jnp.pi / interval
+    const = jnp.full(x.shape + (1,), 1.0 / jnp.sqrt(2.0), dtype=x.dtype)
+    return jnp.concatenate([const, jnp.cos(arg), jnp.sin(arg)], axis=-1)
+
+
+def polynomial_cutoff(d, cutoff: float, p: int = 6):
+    """MACE-style polynomial envelope: 1 at 0, C^2-smooth 0 at cutoff."""
+    x = d / cutoff
+    x = jnp.clip(x, 0.0, 1.0)
+    c1 = -(p + 1.0) * (p + 2.0) / 2.0
+    c2 = p * (p + 2.0)
+    c3 = -p * (p + 1.0) / 2.0
+    return 1.0 + c1 * x**p + c2 * x ** (p + 1) + c3 * x ** (p + 2)
+
+
+def cosine_cutoff(d, cutoff: float):
+    """0.5 (cos(pi d / rc) + 1), zero beyond the cutoff."""
+    return jnp.where(d < cutoff, 0.5 * (jnp.cos(jnp.pi * d / cutoff) + 1.0), 0.0)

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..geometry import apply_strain
 from ..partition.graph import PartitionedGraph
 from .halo import local_graph_from_stacked
 from .mesh import GRAPH_AXIS
@@ -65,9 +66,9 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None):
         axis = GRAPH_AXIS if mesh is not None else None
         lg, _ = local_graph_from_stacked(graph_local, axis)
         dtype = positions.dtype
-        defm = jnp.eye(3, dtype=dtype) + 0.5 * (strain + strain.T).astype(dtype)
-        pos = positions[0] @ defm
-        lg.lattice = lg.lattice.astype(dtype) @ defm
+        pos, lg.lattice = apply_strain(
+            positions[0], lg.lattice.astype(dtype), strain.astype(dtype)
+        )
         pos = lg.halo_exchange(pos)
         e_atoms = model_energy_fn(params, lg, pos)
         return lg.owned_sum(e_atoms.reshape(-1, 1))

@@ -1,0 +1,71 @@
+"""Training: energy/force/stress matching over the graph-parallel mesh.
+
+The reference is inference-only (training stays in upstream libraries,
+reference README.md:53); here training is first-class: the loss
+differentiates through the same sharded potential (halo exchanges included),
+so gradients w.r.t. parameters aggregate across partitions with a psum —
+graph parallelism doubles as data parallelism over space.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .parallel.runtime import make_total_energy
+
+
+def make_loss_fn(model_energy_fn, mesh, w_energy=1.0, w_force=1.0, w_stress=0.0):
+    """Loss: (params, graph, positions, targets) -> scalar.
+
+    targets: dict with 'energy' (),
+             'forces' (P, N_cap, 3) in the graph's local layout,
+             optional 'stress' (3, 3).
+    Forces are compared on owned rows only (halo rows belong to a peer).
+    """
+    total_energy = make_total_energy(model_energy_fn, mesh)
+
+    def loss_fn(params, graph, positions, targets):
+        strain = jnp.zeros((3, 3), dtype=positions.dtype)
+        if w_force > 0.0 or w_stress > 0.0:
+            energy, (g_pos, g_strain) = jax.value_and_grad(
+                total_energy, argnums=(2, 3)
+            )(params, graph, positions, strain)
+            forces = -g_pos
+        else:
+            energy = total_energy(params, graph, positions, strain)
+            forces = None
+        n_atoms = jnp.maximum(graph.n_total_nodes.astype(energy.dtype), 1.0)
+        loss = w_energy * ((energy - targets["energy"]) / n_atoms) ** 2
+        if w_force > 0.0:
+            mask = graph.owned_mask[..., None]
+            diff = jnp.where(mask, forces - targets["forces"], 0.0)
+            loss = loss + w_force * jnp.sum(diff**2) / (3.0 * n_atoms)
+        if w_stress > 0.0:
+            vol = jnp.abs(jnp.linalg.det(graph.lattice.astype(energy.dtype)))
+            stress = g_strain / vol
+            loss = loss + w_stress * jnp.mean((stress - targets["stress"]) ** 2)
+        return loss
+
+    return loss_fn
+
+
+def make_train_step(model_energy_fn, mesh, optimizer, w_energy=1.0, w_force=1.0,
+                    w_stress=0.0):
+    """Jitted SGD/optax step over the sharded loss.
+
+    Returns step(params, opt_state, graph, positions, targets) ->
+    (params, opt_state, loss).
+    """
+    loss_fn = make_loss_fn(model_energy_fn, mesh, w_energy, w_force, w_stress)
+
+    @jax.jit
+    def step(params, opt_state, graph, positions, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, graph, positions, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,109 @@
+"""Simulation-layer tests: DistPotential pipeline, MD ensembles, relaxation."""
+
+import numpy as np
+import pytest
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import (
+    Atoms,
+    DistPotential,
+    MolecularDynamics,
+    Relaxer,
+    TrajectoryObserver,
+)
+from distmlip_tpu.calculators.md import ENSEMBLES
+from distmlip_tpu.models import PairConfig, PairPotential
+
+
+def make_atoms(rng, reps=(3, 3, 3), a=3.8, noise=0.03):
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, noise, (len(frac), 3))
+    return Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
+
+
+@pytest.fixture(scope="module")
+def potential():
+    model = PairPotential(PairConfig(cutoff=3.5, kind="lj"))
+    params = model.init()
+    params = {"eps": params["eps"] * 0.1, "sigma": params["sigma"]}
+    return DistPotential(model, params, num_partitions=2, compute_stress=True)
+
+
+def test_calculate_basic(rng, potential):
+    atoms = make_atoms(rng)
+    res = potential.calculate(atoms)
+    assert np.isfinite(res["energy"])
+    assert res["forces"].shape == (len(atoms), 3)
+    assert res["stress"].shape == (3, 3)
+    assert potential.last_timings["device_s"] > 0
+
+
+def test_partition_report(rng, potential):
+    rep = potential.partition_report(make_atoms(rng))
+    assert "partition 0" in rep and "partition 1" in rep
+
+
+def test_nve_conserves_energy(rng, potential):
+    atoms = make_atoms(rng)
+    atoms.set_maxwell_boltzmann_velocities(300.0, rng=rng)
+    md = MolecularDynamics(atoms, potential, ensemble="nve", timestep=1.0)
+    e0 = md.results["energy"] + atoms.kinetic_energy()
+    md.run(50)
+    e1 = md.results["energy"] + atoms.kinetic_energy()
+    assert abs(e1 - e0) < 5e-3 * len(atoms) ** 0.5  # drift bound
+
+
+@pytest.mark.parametrize(
+    "ensemble", [e for e in ENSEMBLES if e != "nve"]
+)
+def test_ensembles_run_and_thermostat(rng, ensemble, potential):
+    atoms = make_atoms(rng)
+    atoms.set_maxwell_boltzmann_velocities(600.0, rng=rng)
+    md = MolecularDynamics(
+        atoms, potential, ensemble=ensemble, timestep=1.0,
+        temperature=300.0, taut=50.0, seed=1,
+    )
+    md.run(30)
+    assert np.isfinite(md.results["energy"])
+    assert np.all(np.isfinite(atoms.positions))
+    # thermostatted runs should pull T from 600 toward 300
+    if ensemble.startswith("nvt"):
+        assert atoms.temperature() < 650.0
+
+
+def test_trajectory_observer(rng, potential, tmp_path):
+    atoms = make_atoms(rng)
+    obs = TrajectoryObserver(atoms)
+    md = MolecularDynamics(
+        atoms, potential, ensemble="nvt_berendsen", trajectory=obs,
+        logfile=str(tmp_path / "md.log"), loginterval=2,
+    )
+    md.run(10)
+    assert len(obs.energies) == 5
+    obs.save(str(tmp_path / "traj.npz"))
+    data = np.load(tmp_path / "traj.npz")
+    assert data["positions"].shape[0] == 5
+    assert (tmp_path / "md.log").read_text().count("\n") == 5
+
+
+def test_relaxer_reduces_forces(rng, potential):
+    atoms = make_atoms(rng, noise=0.15)
+    res0 = potential.calculate(atoms)
+    relaxer = Relaxer(potential, fmax=0.05)
+    out = relaxer.relax(atoms, steps=200)
+    assert out.converged
+    assert np.abs(out.forces).max() < 0.05
+    assert out.energy < res0["energy"]
+
+
+def test_relaxer_with_cell(rng, potential):
+    atoms = make_atoms(rng, noise=0.05)
+    atoms.cell *= 1.03  # slightly strained
+    atoms.positions *= 1.03
+    relaxer = Relaxer(potential, relax_cell=True, fmax=0.08, smax=0.01)
+    out = relaxer.relax(atoms, steps=300)
+    assert np.abs(out.forces).max() < 0.08
+    # stress reduced vs initial
+    res0 = potential.calculate(atoms)
+    assert np.abs(out.stress).max() <= np.abs(res0["stress"]).max() + 1e-6

@@ -1,0 +1,124 @@
+"""CHGNet model physics + distributed equivalence (bond graph + angles)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distmlip_tpu.models.chgnet import CHGNet, CHGNetConfig
+from tests.utils import make_crystal, run_potential
+
+CFG = CHGNetConfig(
+    num_species=4, units=16, num_rbf=6, num_angle=4, num_blocks=3,
+    cutoff=3.2, bond_cutoff=2.6,
+)
+A_LAT = 3.5  # fcc nn distance a/sqrt(2) = 2.47 A < bond_cutoff
+MODEL = CHGNet(CFG)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return MODEL.init(jax.random.PRNGKey(0))
+
+
+def _run(params, cart, lattice, species, nparts, **kw):
+    return run_potential(
+        MODEL.energy_fn, params, cart, lattice, species, CFG.cutoff, nparts,
+        bond_r=CFG.bond_cutoff, use_bond_graph=True, **kw,
+    )
+
+
+def test_distributed_matches_single_device(rng, params):
+    cart, lattice, species = make_crystal(rng, reps=(8, 4, 4), a=A_LAT)
+    e1, f1, s1 = _run(params, cart, lattice, species, 1)
+    e4, f4, s4 = _run(params, cart, lattice, species, 4)
+    assert np.abs(f1).max() > 1e-2  # non-degeneracy guard
+    assert abs(e1 - e4) < 1e-4 * max(1.0, abs(e1))
+    np.testing.assert_allclose(f1, f4, atol=2e-4)
+    np.testing.assert_allclose(s1, s4, atol=1e-5)
+
+
+def test_angles_affect_energy(rng, params):
+    """The bond-graph path must contribute: disabling it changes the energy."""
+    cart, lattice, species = make_crystal(rng, reps=(3, 3, 3), a=A_LAT)
+    e_bg, _, _ = _run(params, cart, lattice, species, 1)
+    e_nobg, _, _ = run_potential(
+        MODEL.energy_fn, params, cart, lattice, species, CFG.cutoff, 1,
+        bond_r=CFG.bond_cutoff, use_bond_graph=False,
+    )
+    assert abs(e_bg - e_nobg) > 1e-3
+
+
+def test_rotation_invariance(rng, params):
+    cart, lattice, species = make_crystal(rng, reps=(3, 3, 3), a=A_LAT)
+    q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    e1, f1, _ = _run(params, cart, lattice, species, 1)
+    e2, f2, _ = _run(params, cart @ q, lattice @ q, species, 1)
+    assert abs(e1 - e2) < 5e-4 * max(1.0, abs(e1))
+    np.testing.assert_allclose(f1 @ q, f2, atol=3e-4)
+
+
+def test_forces_match_finite_difference(rng, params):
+    jax.config.update("jax_enable_x64", True)
+    try:
+        cart, lattice, species = make_crystal(rng, reps=(2, 2, 2), a=A_LAT, noise=0.08)
+        cart = cart.astype(np.float64)
+
+        def energy(c):
+            from distmlip_tpu.neighbors import neighbor_list_numpy
+            from distmlip_tpu.parallel import make_potential_fn
+            from distmlip_tpu.partition import build_plan, build_partitioned_graph
+
+            nl = neighbor_list_numpy(c, lattice, [1, 1, 1], CFG.cutoff,
+                                     bond_r=CFG.bond_cutoff)
+            plan = build_plan(nl, lattice, [1, 1, 1], 1, CFG.cutoff,
+                              CFG.bond_cutoff, use_bond_graph=True)
+            graph, host = build_partitioned_graph(plan, nl, species, lattice,
+                                                  dtype=np.float64)
+            pot = make_potential_fn(MODEL.energy_fn, None, compute_stress=False)
+            out = pot(jax.tree.map(lambda x: x.astype(np.float64), params),
+                      graph, graph.positions)
+            return float(out["energy"]), host.gather_owned(
+                np.asarray(out["forces"]), len(c))
+
+        _, forces = energy(cart)
+        assert np.abs(forces).max() > 1e-2
+        h = 1e-5
+        for atom, ax in [(0, 0), (7, 1), (13, 2)]:
+            cp, cm = cart.copy(), cart.copy()
+            cp[atom, ax] += h
+            cm[atom, ax] -= h
+            ep, _ = energy(cp)
+            em, _ = energy(cm)
+            f_fd = -(ep - em) / (2 * h)
+            np.testing.assert_allclose(forces[atom, ax], f_fd, rtol=1e-5, atol=1e-7)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_energy_smooth_at_cutoff(rng, params):
+    lattice = np.eye(3) * 20.0
+    species = np.zeros(3, np.int32)
+    es = []
+    for d in np.linspace(CFG.cutoff - 0.02, CFG.cutoff + 0.02, 9):
+        cart = np.array([[5.0, 5.0, 5.0], [5.0 + d, 5.0, 5.0], [5.0, 6.5, 5.0]])
+        # third atom within bond range of atom 0 -> line graph non-empty
+        e, _, _ = _run(params, cart, lattice, species, 1, compute_stress=False)
+        es.append(e)
+    assert np.ptp(es) < 2e-3
+
+
+def test_magmom_readout(rng, params):
+    cart, lattice, species = make_crystal(rng, reps=(2, 2, 2), a=A_LAT)
+    from distmlip_tpu.neighbors import neighbor_list_numpy
+    from distmlip_tpu.parallel.halo import local_graph_from_stacked
+    from distmlip_tpu.partition import build_plan, build_partitioned_graph
+
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], CFG.cutoff)
+    plan = build_plan(nl, lattice, [1, 1, 1], 1, CFG.cutoff)
+    graph, host = build_partitioned_graph(plan, nl, species, lattice)
+    lg, pos = local_graph_from_stacked(graph, None)
+    m = MODEL.magmom_fn(params, lg, pos)
+    assert m.shape == (graph.n_cap,)
+    assert np.all(np.asarray(m)[: len(cart)] >= 0)

@@ -1,0 +1,32 @@
+"""Shared helpers for model tests: build systems, run potentials."""
+
+import numpy as np
+
+from distmlip_tpu import geometry
+from distmlip_tpu.neighbors import neighbor_list_numpy
+from distmlip_tpu.parallel import graph_mesh, make_potential_fn
+from distmlip_tpu.partition import build_plan, build_partitioned_graph
+
+
+def make_crystal(rng, reps=(4, 4, 4), a=4.0, noise=0.05, n_species=2):
+    """Perturbed fcc-ish supercell with random species."""
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, noise, (len(frac), 3))
+    species = rng.integers(0, n_species, len(frac)).astype(np.int32)
+    return cart, lattice, species
+
+
+def run_potential(
+    energy_fn, params, cart, lattice, species, r, nparts,
+    bond_r=0.0, use_bond_graph=False, caps=None, compute_stress=True,
+):
+    """Full pipeline: neighbors -> partition -> graph -> potential."""
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], r, bond_r=bond_r)
+    plan = build_plan(nl, lattice, [1, 1, 1], nparts, r, bond_r, use_bond_graph)
+    graph, host = build_partitioned_graph(plan, nl, species, lattice, caps=caps)
+    mesh = graph_mesh(nparts) if nparts > 1 else None
+    pot = make_potential_fn(energy_fn, mesh, compute_stress=compute_stress)
+    out = pot(params, graph, graph.positions)
+    forces = host.gather_owned(np.asarray(out["forces"]), len(cart))
+    return float(out["energy"]), forces, np.asarray(out["stress"])

@@ -1,10 +1,11 @@
-"""Molecular dynamics driver with 8 ensembles.
+"""Molecular dynamics driver with 9 ensembles.
 
-Self-contained equivalents of the reference's ASE-backed ensemble zoo
-(reference implementations/matgl/ase.py:228-463: nve, nvt (Berendsen),
-nvt_langevin, nvt_andersen, nvt_bussi, npt (inhomogeneous Berendsen),
-npt_berendsen, npt_nose_hoover). Integrators run on the host in float64;
-each step calls the distributed potential once (velocity-Verlet based).
+Self-contained equivalents (plus nvt_nose_hoover) of the reference's
+ASE-backed ensemble zoo (reference implementations/matgl/ase.py:228-463):
+nve, nvt_berendsen, nvt_langevin, nvt_andersen, nvt_bussi, nvt_nose_hoover,
+npt_berendsen, npt_inhomogeneous_berendsen, npt_nose_hoover. Integrators run
+on the host in float64; each step calls the distributed potential once
+(velocity-Verlet based).
 
 Units: Å, fs, eV, amu, K; pressure in GPa at the API (converted internally).
 """
@@ -84,6 +85,11 @@ class MolecularDynamics:
     ):
         if ensemble not in ENSEMBLES:
             raise ValueError(f"ensemble {ensemble!r} not in {ENSEMBLES}")
+        if ensemble.startswith("npt") and not getattr(potential, "compute_stress", True):
+            raise ValueError(
+                "NPT ensembles need stresses: build the potential with "
+                "compute_stress=True"
+            )
         self.atoms = atoms
         self.potential = potential
         self.ensemble = ensemble

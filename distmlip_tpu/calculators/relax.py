@@ -1,9 +1,10 @@
-"""Structure relaxation: FIRE optimizer with optional cell relaxation.
+"""Structure relaxation: FIRE / L-BFGS with optional cell relaxation.
 
 Reference analogue: the Relaxer with ASE FIRE/BFGS + Frechet/Exp cell
-filters (reference implementations/matgl/ase.py:130-223). Here FIRE runs
-over a combined (positions, strain) degree-of-freedom vector — the strain
-block plays the role of ASE's cell filters.
+filters (reference implementations/matgl/ase.py:130-223; optimizer enum
+:40-50). Both optimizers run over a combined (positions, strain)
+degree-of-freedom vector — the strain block plays the role of ASE's cell
+filters.
 """
 
 from __future__ import annotations
@@ -30,6 +31,7 @@ class Relaxer:
     def __init__(
         self,
         potential,
+        optimizer: str = "fire",     # "fire" | "lbfgs"
         relax_cell: bool = False,
         fmax: float = 0.05,          # eV/Å
         smax: float = 0.005,         # eV/Å^3 (cell gradient tolerance)
@@ -42,7 +44,10 @@ class Relaxer:
         f_alpha: float = 0.99,
         cell_factor: float | None = None,  # None -> len(atoms), balances cell vs position DOFs
     ):
+        if optimizer not in ("fire", "lbfgs"):
+            raise ValueError(f"optimizer {optimizer!r} not in ('fire', 'lbfgs')")
         self.potential = potential
+        self.optimizer = optimizer
         self.relax_cell = relax_cell
         self.fmax = fmax
         self.smax = smax
@@ -56,6 +61,7 @@ class Relaxer:
         n = len(atoms)
         cell_factor = self.cell_factor if self.cell_factor is not None else max(n, 1)
         v = np.zeros((n + 3, 3))
+        lbfgs_state = {"s": [], "y": [], "g_prev": None, "m": 10}
         dt = self.dt_start
         alpha = self.alpha_start
         n_pos = 0
@@ -78,6 +84,17 @@ class Relaxer:
             if f_norm < self.fmax and (not self.relax_cell or s_norm < self.smax):
                 converged = True
                 break
+
+            if self.optimizer == "lbfgs":
+                step_vec = self._lbfgs_step(g, lbfgs_state)
+                atoms.positions += step_vec[:n]
+                if self.relax_cell:
+                    strain = step_vec[n:] / max(atoms.volume, 1.0) * cell_factor
+                    defm = np.eye(3) + 0.5 * (strain + strain.T)
+                    atoms.cell = atoms.cell @ defm
+                    atoms.positions = atoms.positions @ defm
+                res = self.potential.calculate(atoms)
+                continue
 
             # FIRE velocity mixing
             p = float(np.vdot(g, v))
@@ -112,3 +129,44 @@ class Relaxer:
             atoms=atoms, converged=converged, nsteps=it, energy=res["energy"],
             forces=res["forces"], stress=res["stress"], trajectory=traj,
         )
+
+    def _lbfgs_step(self, g, state):
+        """L-BFGS two-loop recursion on the downhill gradient g (= -grad E).
+
+        Tracks (s, y) pairs internally; returns the proposed step (same shape
+        as g). Uses a conservative initial scaling and resets on curvature
+        breakdown.
+        """
+        grad = -g.ravel()  # actual gradient of E
+        if state["g_prev"] is not None:
+            s_vec = state["step_prev"]
+            y_vec = grad - state["g_prev"]
+            sy = float(s_vec @ y_vec)
+            if sy > 1e-10:
+                state["s"].append(s_vec)
+                state["y"].append(y_vec)
+                if len(state["s"]) > state["m"]:
+                    state["s"].pop(0)
+                    state["y"].pop(0)
+        q = grad.copy()
+        alphas = []
+        for s_vec, y_vec in zip(reversed(state["s"]), reversed(state["y"])):
+            rho = 1.0 / (s_vec @ y_vec)
+            a = rho * (s_vec @ q)
+            alphas.append((a, rho, s_vec, y_vec))
+            q -= a * y_vec
+        if state["s"]:
+            s_vec, y_vec = state["s"][-1], state["y"][-1]
+            q *= (s_vec @ y_vec) / max(y_vec @ y_vec, 1e-12)
+        else:
+            q *= 0.05  # first-step damping
+        for a, rho, s_vec, y_vec in reversed(alphas):
+            b = rho * (y_vec @ q)
+            q += (a - b) * s_vec
+        step = -q
+        max_step = np.abs(step).max()
+        if max_step > 0.2:  # trust radius; store the APPLIED step for (s, y)
+            step *= 0.2 / max_step
+        state["g_prev"] = grad
+        state["step_prev"] = step
+        return step.reshape(g.shape)

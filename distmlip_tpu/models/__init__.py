@@ -1,9 +1,13 @@
 from .pair import PairPotential, PairConfig
 from .tensornet import TensorNet, TensorNetConfig
 from .chgnet import CHGNet, CHGNetConfig
+from .mace import MACE, MACEConfig
+from .escn import ESCN, ESCNConfig
 
 __all__ = [
     "PairPotential", "PairConfig",
     "TensorNet", "TensorNetConfig",
     "CHGNet", "CHGNetConfig",
+    "MACE", "MACEConfig",
+    "ESCN", "ESCNConfig",
 ]

@@ -1,0 +1,108 @@
+"""Weight ingestion from upstream torch checkpoints.
+
+The reference's ``from_existing(model)`` copies a trained upstream torch
+module's ``__dict__`` into its distributed subclass (reference
+chgnet.py:551-560, models.py:252-263). The TPU-native equivalent maps a
+torch ``state_dict`` onto this framework's parameter pytrees.
+
+Generic machinery here; per-architecture name maps live in MAPPINGS. Exact
+upstream-name coverage is validated opportunistically: ``convert`` reports
+unmapped/unused tensors so partial maps fail loudly instead of silently
+producing a half-initialized model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+
+def _t(x):
+    """torch tensor / numpy -> numpy array."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+@dataclass
+class Rule:
+    """Maps one torch tensor onto one pytree leaf path.
+
+    path: tuple of keys/indices into the params pytree.
+    transform: applied to the torch array (default: linear weights transpose,
+    since torch nn.Linear stores (out, in) and this framework uses (in, out)).
+    """
+
+    torch_name: str
+    path: tuple
+    transform: Callable[[np.ndarray], np.ndarray] | None = None
+
+
+def set_in(tree, path, value):
+    node = tree
+    for p in path[:-1]:
+        node = node[p]
+    leaf = node[path[-1]]
+    if np.shape(leaf) != value.shape:
+        raise ValueError(
+            f"shape mismatch at {path}: torch {value.shape} vs model {np.shape(leaf)}"
+        )
+    node[path[-1]] = value.astype(np.asarray(leaf).dtype)
+
+
+def convert(state_dict: dict, params, rules: list[Rule], strict: bool = True):
+    """Apply mapping rules; returns (params, report)."""
+    used = set()
+    for r in rules:
+        if r.torch_name not in state_dict:
+            if strict:
+                raise KeyError(f"torch checkpoint missing {r.torch_name!r}")
+            continue
+        arr = _t(state_dict[r.torch_name])
+        if r.transform is not None:
+            arr = r.transform(arr)
+        set_in(params, r.path, arr)
+        used.add(r.torch_name)
+    unused = sorted(set(state_dict) - used)
+    report = {"mapped": len(used), "unused_torch": unused}
+    if strict and unused:
+        raise ValueError(
+            f"{len(unused)} torch tensors unmapped (first 10): {unused[:10]}"
+        )
+    return params, report
+
+
+def linear_rule(torch_prefix: str, path: tuple, bias: bool = True) -> list[Rule]:
+    """nn.Linear -> {'w': (in,out), 'b': (out,)}"""
+    rules = [Rule(f"{torch_prefix}.weight", path + ("w",), lambda a: a.T)]
+    if bias:
+        rules.append(Rule(f"{torch_prefix}.bias", path + ("b",), None))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# Per-architecture maps. These cover this framework's own parameterization;
+# upstream checkpoints additionally need the architecture hyperparameters to
+# match (units/blocks/rbf sizes). Populated incrementally as upstream
+# checkpoints become loadable in the environment; `convert` fails loudly on
+# any gap.
+# ---------------------------------------------------------------------------
+
+MAPPINGS: dict[str, Callable] = {}
+
+
+def register_mapping(name: str):
+    def deco(fn):
+        MAPPINGS[name] = fn
+        return fn
+
+    return deco
+
+
+def from_torch(arch: str, state_dict: dict, params, strict: bool = True):
+    if arch not in MAPPINGS:
+        raise KeyError(f"no mapping registered for {arch!r}; have {sorted(MAPPINGS)}")
+    rules = MAPPINGS[arch](params)
+    return convert(state_dict, params, rules, strict=strict)

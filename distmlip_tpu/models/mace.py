@@ -1,0 +1,334 @@
+"""MACE: higher-order equivariant message passing (ACE product basis).
+
+TPU-native implementation of the MACE architecture (Batatia et al. 2022) —
+the reference's flagship distributed family (reference
+implementations/mace/models.py:45-220: per-partition embeddings ->
+interaction -> product -> readout loop with an atom_transfer after every
+interaction). Built entirely on this repo's SO(3) module (real spherical
+harmonics + real coupling tensors, ops/so3.py) instead of e3nn.
+
+Feature layout: equivariant node features are a dict {l: (N, C, 2l+1)}.
+Message construction (density projection):
+    A_i^{l3} = (1/avg_n) sum_j sum_{l1,l2} R^{l1l2l3}(r_ij) *
+               CG[(l1,l2,l3)] (h_j^{l1}, Y^{l2}(r_ij))
+followed by a species-weighted symmetric contraction (correlation <= 3,
+iterated pairwise couplings — spans the ACE product basis) and linear
+updates with residual connections. Per-layer invariant readouts accumulate
+into the site energy, matching MACE's scale/shift + E0s structure.
+
+Distributed contract: one halo exchange of the packed node features after
+each interaction (same cadence as the reference's atom_transfer,
+models.py:165).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import radial
+from ..ops.nn import linear, linear_init, linear_init_vp, mlp, mlp_init
+from ..ops.segment import masked_segment_sum
+from ..ops.so3 import real_clebsch_gordan, spherical_harmonics
+
+
+@dataclass(frozen=True)
+class MACEConfig:
+    num_species: int = 95
+    channels: int = 64
+    l_max: int = 3            # spherical-harmonic order on edges
+    a_lmax: int = 2           # irreps kept in the density A / product basis
+    hidden_lmax: int = 1      # irreps of hidden node features (0..L)
+    correlation: int = 3      # body order - 1 (ACE correlation)
+    num_interactions: int = 2
+    num_bessel: int = 8
+    radial_mlp: int = 64
+    radial_scale: float = 4.0  # output gain on the radial MLP: keeps the
+                               # density projection A at O(1) so correlation-2/3
+                               # products carry weight at init
+    cutoff: float = 5.0
+    avg_num_neighbors: float = 14.0
+    remat: bool = True   # rematerialize each interaction in the backward pass
+    edge_chunk: int = 32768  # process edges in chunks of this size inside a
+                             # lax.scan: bounds the per-edge path-tensor and
+                             # radial-weight memory regardless of system size
+                             # (0 disables chunking)
+    dtype: str = "float32"
+
+
+def _triangle(l1, l2, l3):
+    return abs(l1 - l2) <= l3 <= l1 + l2
+
+
+def _message_paths(h_ls, l_max, out_ls):
+    """(l_h, l_Y, l_out) combos for the density projection."""
+    return [
+        (lh, ly, lo)
+        for lh in h_ls
+        for ly in range(l_max + 1)
+        for lo in out_ls
+        if _triangle(lh, ly, lo)
+    ]
+
+
+def _pair_paths(a_ls):
+    """(la, lb, li) pairwise couplings, la <= lb, dropping identically-zero
+    antisymmetric couplings of identical inputs."""
+    out = []
+    for la in a_ls:
+        for lb in a_ls:
+            if lb < la:
+                continue
+            for li in range(abs(la - lb), min(la + lb, max(a_ls)) + 1):
+                if la == lb and (la + lb + li) % 2 == 1:
+                    continue
+                out.append((la, lb, li))
+    return out
+
+
+def _triple_paths(pairs, a_ls, out_ls):
+    """(pair_index, lc, lout) couplings for correlation 3."""
+    return [
+        (pi, lc, lo)
+        for pi, (la, lb, li) in enumerate(pairs)
+        for lc in a_ls
+        for lo in out_ls
+        if _triangle(li, lc, lo)
+    ]
+
+
+class MACE:
+    def __init__(self, config: MACEConfig = MACEConfig()):
+        self.cfg = config
+        c = config
+        self.h_ls0 = [0]
+        self.h_ls = list(range(c.hidden_lmax + 1))
+        self.a_ls = list(range(c.a_lmax + 1))
+        self.msg_paths = []  # per interaction
+        for t in range(c.num_interactions):
+            h_ls = self.h_ls0 if t == 0 else self.h_ls
+            self.msg_paths.append(_message_paths(h_ls, c.l_max, self.a_ls))
+        self.pairs = _pair_paths(self.a_ls)
+        self.pairs_out = [p for p in self.pairs if p[2] <= c.hidden_lmax]
+        self.triples = (
+            _triple_paths(self.pairs, self.a_ls, self.h_ls)
+            if c.correlation >= 3
+            else []
+        )
+
+    def _cg(self, l1, l2, l3, dtype):
+        return jnp.asarray(real_clebsch_gordan(l1, l2, l3), dtype=dtype)
+
+    # ---- parameters ----
+    def init(self, key) -> dict:
+        cfg = self.cfg
+        C = cfg.channels
+        n_keys = 8 + cfg.num_interactions * 32
+        ks = iter(jax.random.split(key, n_keys))
+        params = {
+            "species_emb": {"w": jax.random.normal(next(ks), (cfg.num_species, C))},
+            "species_ref": {"w": jnp.zeros((cfg.num_species,))},
+            "scale": jnp.ones(()),
+            "shift": jnp.zeros(()),
+            "interactions": [],
+        }
+        for t in range(cfg.num_interactions):
+            n_paths = len(self.msg_paths[t])
+            inter = {
+                # per-l channel mixing of the sender features
+                "lin_up": {
+                    str(l): linear_init_vp(next(ks), C, C)
+                    for l in (self.h_ls0 if t == 0 else self.h_ls)
+                },
+                "radial": mlp_init(
+                    next(ks), [cfg.num_bessel, cfg.radial_mlp, n_paths * C]
+                ),
+                "lin_A": {
+                    str(l): linear_init_vp(next(ks), C, C) for l in self.a_ls
+                },
+                # species-dependent product-basis weights
+                "w1": jax.random.normal(next(ks), (cfg.num_species, len(self.h_ls), C))
+                * 0.5,
+                "w2": jax.random.normal(
+                    next(ks), (cfg.num_species, max(len(self.pairs_out), 1), C)
+                )
+                * 0.5,
+                "w3": jax.random.normal(
+                    next(ks), (cfg.num_species, max(len(self.triples), 1), C)
+                )
+                * 0.5,
+                "lin_msg": {
+                    str(l): linear_init_vp(next(ks), C, C) for l in self.h_ls
+                },
+                "lin_res": {
+                    str(l): linear_init_vp(next(ks), C, C)
+                    for l in (self.h_ls0 if t == 0 else self.h_ls)
+                },
+                "readout": (
+                    mlp_init(next(ks), [C, 16, 1])
+                    if t == cfg.num_interactions - 1
+                    else [linear_init(next(ks), C, 1)]
+                ),
+            }
+            params["interactions"].append(inter)
+        return params
+
+    # ---- packing helpers for the halo exchange ----
+    def _pack(self, h):
+        return jnp.concatenate(
+            [h[l].reshape(h[l].shape[0], -1) for l in sorted(h)], axis=-1
+        )
+
+    def _unpack(self, flat, ls, C):
+        out = {}
+        o = 0
+        for l in ls:
+            d = C * (2 * l + 1)
+            out[l] = flat[:, o : o + d].reshape(-1, C, 2 * l + 1)
+            o += d
+        return out
+
+    # ---- forward ----
+    def energy_fn(self, params, lg, positions):
+        cfg = self.cfg
+        C = cfg.channels
+        dtype = positions.dtype
+
+        vec = lg.edge_vectors(positions)
+        d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
+        rhat = vec / jnp.maximum(d, 1e-9)[:, None]
+        env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
+        bessel = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel)
+        Y = {l: spherical_harmonics(l, rhat) for l in range(cfg.l_max + 1)}
+
+        z = lg.species
+        h = {0: params["species_emb"]["w"][z][:, :, None]}  # (N, C, 1)
+        h = self._unpack(lg.halo_exchange(self._pack(h)), [0], C)
+
+        e_site = params["species_ref"]["w"][z].astype(dtype)
+        acc = jnp.zeros(positions.shape[0], dtype=dtype)
+
+        for t, inter in enumerate(params["interactions"]):
+            body = partial(self._interaction, lg=lg, Y=Y, bessel=bessel, env=env,
+                           z=z, t=t)
+            if cfg.remat:
+                body = jax.checkpoint(body)
+            h = body(inter, h)
+            h = self._unpack(lg.halo_exchange(self._pack(h)), self.h_ls, C)
+
+            # invariant readout
+            scalars = h[0][:, :, 0]
+            if t == cfg.num_interactions - 1:
+                acc = acc + mlp(inter["readout"], scalars)[:, 0]
+            else:
+                acc = acc + linear(inter["readout"][0], scalars)[:, 0]
+
+        return e_site + params["scale"] * acc + params["shift"]
+
+    def _interaction(self, inter, h, *, lg, Y, bessel, env, z, t):
+        """One MACE interaction: density projection + symmetric contraction +
+        linear update. Rematerialized under grad when cfg.remat (the per-edge
+        per-path tensors dominate activation memory)."""
+        cfg = self.cfg
+        C = cfg.channels
+        dtype = env.dtype
+        n_nodes = h[0].shape[0]
+        h_ls = self.h_ls0 if t == 0 else self.h_ls
+        paths = self.msg_paths[t]
+
+        # sender features, channel-mixed per l
+        hu = {
+            l: jnp.einsum("ncm,cd->ndm", h[l], inter["lin_up"][str(l)]["w"])
+            for l in h_ls
+        }
+
+        # density projection A, accumulated over edge chunks (memory-bounded)
+        e_cap = lg.edge_src.shape[0]
+        chunk = cfg.edge_chunk if cfg.edge_chunk > 0 else e_cap
+        chunk = min(chunk, e_cap)
+        K = -(-e_cap // chunk)
+        pad = K * chunk - e_cap
+
+        def pad_c(x, fill=0):
+            if pad == 0:
+                return x
+            widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths, constant_values=fill)
+
+        src_ch = pad_c(lg.edge_src).reshape(K, chunk)
+        dst_ch = pad_c(lg.edge_dst).reshape(K, chunk)
+        mask_ch = pad_c(lg.edge_mask).reshape(K, chunk)
+        env_ch = pad_c(env).reshape(K, chunk)
+        bes_ch = pad_c(bessel).reshape(K, chunk, -1)
+        Y_ch = {l: pad_c(Y[l]).reshape(K, chunk, -1) for l in Y}
+
+        def chunk_body(A_acc, xs):
+            srcc, dstc, maskc, envc, besc, Yc = xs
+            Rc = mlp(inter["radial"], besc).reshape(chunk, len(paths), C) * (
+                cfg.radial_scale * envc
+            )[:, None, None]
+            for pi, (lh, ly, lo) in enumerate(paths):
+                cgt = self._cg(lh, ly, lo, dtype)
+                m = jnp.einsum(
+                    "ecm,en,mnp->ecp", hu[lh][srcc], Yc[ly], cgt
+                ) * Rc[:, pi, :, None]
+                A_acc[lo] = A_acc[lo] + masked_segment_sum(
+                    m, dstc, A_acc[lo].shape[0], maskc
+                )
+            return A_acc, None
+
+        A0 = {
+            l: jnp.zeros((n_nodes, C, 2 * l + 1), dtype=dtype)
+            for l in self.a_ls
+        }
+        if K == 1:
+            A, _ = chunk_body(A0, (src_ch[0], dst_ch[0], mask_ch[0], env_ch[0],
+                                   bes_ch[0], {l: Y_ch[l][0] for l in Y_ch}))
+        else:
+            body = jax.checkpoint(chunk_body) if cfg.remat else chunk_body
+            A, _ = jax.lax.scan(
+                body, A0,
+                (src_ch, dst_ch, mask_ch, env_ch, bes_ch, Y_ch),
+            )
+        inv_avg = jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)
+        A = {
+            l: jnp.einsum("ncm,cd->ndm", A[l] * inv_avg, inter["lin_A"][str(l)]["w"])
+            for l in self.a_ls
+        }
+
+        # symmetric contraction (correlation <= 3), species-weighted
+        w1 = inter["w1"][z]  # (N, |h_ls|, C)
+        w2 = inter["w2"][z]
+        w3 = inter["w3"][z]
+        B = {l: w1[:, i, :, None] * A[l] for i, l in enumerate(self.h_ls)}
+        if cfg.correlation >= 2:
+            P = []
+            out_i = 0
+            for la, lb, li in self.pairs:
+                cgt = self._cg(la, lb, li, dtype)
+                p = jnp.einsum("ncm,ncq,mqp->ncp", A[la], A[lb], cgt)
+                P.append((li, p))
+                if li <= cfg.hidden_lmax:
+                    B[li] = B[li] + w2[:, out_i, :, None] * p
+                    out_i += 1
+            if cfg.correlation >= 3:
+                for ti, (pi, lc, lo) in enumerate(self.triples):
+                    li, p = P[pi]
+                    cgt = self._cg(li, lc, lo, dtype)
+                    q = jnp.einsum("ncm,ncq,mqp->ncp", p, A[lc], cgt)
+                    B[lo] = B[lo] + w3[:, ti, :, None] * q
+
+        # message linear + residual update
+        h_new = {}
+        for l in self.h_ls:
+            m = jnp.einsum("ncm,cd->ndm", B[l], inter["lin_msg"][str(l)]["w"])
+            if l in h and str(l) in inter["lin_res"]:
+                m = m + jnp.einsum(
+                    "ncm,cd->ndm", h[l], inter["lin_res"][str(l)]["w"]
+                )
+            h_new[l] = m
+        return h_new

@@ -133,3 +133,117 @@ def neighbor_list(
     finally:
         lib.dm_neighbor_free(handle)
     return NeighborList(src, dst, offsets, distances, bond_mask.astype(bool), wrapped, shift)
+
+
+# ---------------------------------------------------------------------------
+# Native partitioner bindings (partition.cpp)
+# ---------------------------------------------------------------------------
+
+def _partition_symbols(lib):
+    if getattr(lib, "_partition_ready", False):
+        return lib
+    lib.dm_partition_build.restype = ctypes.c_void_p
+    lib.dm_partition_build.argtypes = [
+        ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+    ]
+    lib.dm_partition_err.restype = ctypes.c_int
+    lib.dm_partition_err.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.dm_partition_sizes.restype = None
+    lib.dm_partition_sizes.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.POINTER(ctypes.c_int64)]
+    lib.dm_partition_copy.restype = None
+    lib.dm_partition_copy.argtypes = [ctypes.c_void_p, ctypes.c_int64] + [
+        ctypes.POINTER(ctypes.c_int64)
+    ] * 12
+    lib.dm_partition_free.restype = None
+    lib.dm_partition_free.argtypes = [ctypes.c_void_p]
+    lib._partition_ready = True
+    return lib
+
+
+def native_partition(src, dst, frac_axis, walls, num_partitions, bond_mask,
+                     use_bond_graph, num_threads=None):
+    """Run the native partitioner; returns per-partition dict arrays.
+
+    Returns None if the native library is unavailable. Raises RuntimeError
+    with the offending node on a multi-destination border node (same
+    condition the numpy oracle raises PartitionError for).
+    """
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    _partition_symbols(lib)
+    if num_threads is None:
+        num_threads = resolve_num_threads()
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    frac_axis = np.ascontiguousarray(frac_axis, dtype=np.float64)
+    walls = np.ascontiguousarray(walls, dtype=np.float64)
+    bm = np.ascontiguousarray(
+        bond_mask if bond_mask is not None else np.zeros(len(src), bool),
+        dtype=np.uint8,
+    )
+    n, ne, P = len(frac_axis), len(src), int(num_partitions)
+    h = lib.dm_partition_build(
+        n, ne, _ptr(src, ctypes.c_int64), _ptr(dst, ctypes.c_int64),
+        _ptr(frac_axis, ctypes.c_double), _ptr(walls, ctypes.c_double),
+        P, _ptr(bm, ctypes.c_uint8), int(bool(use_bond_graph)), int(num_threads),
+    )
+    try:
+        err_node = ctypes.c_int64(-1)
+        err = lib.dm_partition_err(h, ctypes.byref(err_node))
+        if err != 0:
+            raise RuntimeError(
+                f"native partitioner: node {err_node.value} reaches multiple "
+                f"partitions (code {err}); reduce num_partitions"
+            )
+        out = []
+        null = ctypes.POINTER(ctypes.c_int64)()
+        for p in range(P):
+            sizes = np.zeros(5, dtype=np.int64)
+            lib.dm_partition_sizes(h, p, _ptr(sizes, ctypes.c_int64))
+            nn, nee, nb, nl, nm = map(int, sizes)
+            d = {
+                "global_ids": np.empty(nn, np.int64),
+                "node_markers": np.empty(2 * P + 2, np.int64),
+                "edge_ids": np.empty(nee, np.int64),
+                "src_local": np.empty(nee, np.int64),
+                "dst_local": np.empty(nee, np.int64),
+            }
+            if use_bond_graph:
+                d.update(
+                    bond_markers=np.empty(2 * P + 2, np.int64),
+                    bond_global_edge=np.empty(nb, np.int64),
+                    line_src=np.empty(nl, np.int64),
+                    line_dst=np.empty(nl, np.int64),
+                    line_center=np.empty(nl, np.int64),
+                    bm_edge=np.empty(nm, np.int64),
+                    bm_bond=np.empty(nm, np.int64),
+                )
+            args = [
+                _ptr(d["global_ids"], ctypes.c_int64),
+                _ptr(d["node_markers"], ctypes.c_int64),
+                _ptr(d["edge_ids"], ctypes.c_int64),
+                _ptr(d["src_local"], ctypes.c_int64),
+                _ptr(d["dst_local"], ctypes.c_int64),
+            ]
+            if use_bond_graph:
+                args += [
+                    _ptr(d["bond_markers"], ctypes.c_int64),
+                    _ptr(d["bond_global_edge"], ctypes.c_int64),
+                    _ptr(d["line_src"], ctypes.c_int64),
+                    _ptr(d["line_dst"], ctypes.c_int64),
+                    _ptr(d["line_center"], ctypes.c_int64),
+                    _ptr(d["bm_edge"], ctypes.c_int64),
+                    _ptr(d["bm_bond"], ctypes.c_int64),
+                ]
+            else:
+                args += [null] * 7
+            lib.dm_partition_copy(h, p, *args)
+            out.append(d)
+        return out
+    finally:
+        lib.dm_partition_free(h)

@@ -40,6 +40,11 @@ def linear(p, x):
     return y
 
 
+def linear_init_vp(key, d_in: int, d_out: int):
+    """Variance-preserving linear init (e3nn convention): W ~ N(0, 1/d_in)."""
+    return {"w": jax.random.normal(key, (d_in, d_out)) / np.sqrt(d_in)}
+
+
 def mlp_init(key, dims: list[int], bias: bool = True):
     keys = jax.random.split(key, len(dims) - 1)
     return [linear_init(k, a, b, bias=bias) for k, a, b in zip(keys, dims[:-1], dims[1:])]

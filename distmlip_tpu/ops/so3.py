@@ -1,0 +1,195 @@
+"""SO(3) machinery: real spherical harmonics and real coupling tensors.
+
+Self-contained replacement for the e3nn pieces MACE-style equivariant models
+need (no e3nn-jax in this image): hardcoded real spherical harmonics up to
+l=3 (component normalization, ||Y_l||^2 = 2l+1, matching e3nn's default) and
+real-basis Clebsch-Gordan coupling tensors, cached per (l1, l2, l3).
+
+The coupling tensor for (l1, l2, l3) is constructed numerically as the
+(unique, multiplicity-one) invariant of D_l1 x D_l2 x D_l3 over random
+rotations, where the real Wigner matrices D_l are themselves derived from
+THESE spherical harmonics — so the tensors match this basis by construction,
+with no phase-convention bookkeeping. Equivariance is verified in
+tests/test_so3.py.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Real spherical harmonics (component normalization), l = 0..3.
+# Input: unit vectors (..., 3) ordered (x, y, z). Output: (..., 2l+1), m from
+# -l..l in e3nn order.
+# ---------------------------------------------------------------------------
+
+def _sh_impl(l: int, u, xp):
+    x, y, z = u[..., 0], u[..., 1], u[..., 2]
+    if l == 0:
+        return xp.ones(u.shape[:-1] + (1,), dtype=u.dtype)
+    if l == 1:
+        s3 = np.sqrt(3.0)
+        return xp.stack([s3 * x, s3 * y, s3 * z], axis=-1)
+    if l == 2:
+        s15, s5 = np.sqrt(15.0), np.sqrt(5.0)
+        return xp.stack(
+            [
+                s15 * x * y,
+                s15 * y * z,
+                s5 / 2.0 * (3.0 * z * z - 1.0),
+                s15 * x * z,
+                s15 / 2.0 * (x * x - y * y),
+            ],
+            axis=-1,
+        )
+    if l == 3:
+        s = np.sqrt
+        return xp.stack(
+            [
+                s(35.0 / 8.0) * y * (3 * x * x - y * y),
+                s(105.0) * x * y * z,
+                s(21.0 / 8.0) * y * (5 * z * z - 1.0),
+                s(7.0) / 2.0 * z * (5 * z * z - 3.0),
+                s(21.0 / 8.0) * x * (5 * z * z - 1.0),
+                s(105.0) / 2.0 * z * (x * x - y * y),
+                s(35.0 / 8.0) * x * (x * x - 3 * y * y),
+            ],
+            axis=-1,
+        )
+    raise NotImplementedError(f"l={l} > 3")
+
+
+def spherical_harmonics(l: int, u):
+    """Device (jax) real spherical harmonics of unit vectors."""
+    return _sh_impl(l, u, jnp)
+
+
+def spherical_harmonics_np(l: int, u: np.ndarray) -> np.ndarray:
+    """Host (numpy, float64) variant — used to build coupling tensors."""
+    return _sh_impl(l, np.asarray(u, dtype=np.float64), np)
+
+
+def spherical_harmonics_stack(l_max: int, u):
+    """Concatenated [Y_0, Y_1, ..., Y_lmax]: (..., (l_max+1)^2)."""
+    return jnp.concatenate([spherical_harmonics(l, u) for l in range(l_max + 1)], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Real Wigner matrices and coupling tensors (host-side, float64, cached).
+# ---------------------------------------------------------------------------
+
+def wigner_d_from_rotation(l: int, R: np.ndarray) -> np.ndarray:
+    """Real Wigner matrix with Y_l(R u) = D_l(R) Y_l(u), by least squares."""
+    rng = np.random.default_rng(12345)
+    pts = rng.normal(size=(max(64, 4 * (2 * l + 1)), 3))
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    Y = spherical_harmonics_np(l, pts)
+    Yr = spherical_harmonics_np(l, pts @ np.asarray(R, dtype=np.float64).T)
+    D, *_ = np.linalg.lstsq(Y, Yr, rcond=None)
+    return D.T
+
+
+def _random_rotation(rng) -> np.ndarray:
+    q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    return q
+
+
+@lru_cache(maxsize=None)
+def real_clebsch_gordan(l1: int, l2: int, l3: int) -> np.ndarray | None:
+    """Real-basis coupling tensor C (2l1+1, 2l2+1, 2l3+1), or None if the
+    triangle inequality fails.
+
+    Unique invariant of D_l1 x D_l2 x D_l3 (multiplicity one for SO(3)),
+    found as the null space of stacked (D1xD2xD3 - I) constraints over
+    random rotations. Normalized to sum(C^2) = 2*l3+1 so that coupling two
+    component-normalized inputs stays component-normalized; sign fixed by
+    making the first significant entry positive.
+    """
+    if not (abs(l1 - l2) <= l3 <= l1 + l2):
+        return None
+    d1, d2, d3 = 2 * l1 + 1, 2 * l2 + 1, 2 * l3 + 1
+    d = d1 * d2 * d3
+    rng = np.random.default_rng(2024)
+    rows = []
+    for _ in range(4):
+        R = _random_rotation(rng)
+        D = np.einsum(
+            "xa,yb,zc->xyzabc",
+            wigner_d_from_rotation(l1, R),
+            wigner_d_from_rotation(l2, R),
+            wigner_d_from_rotation(l3, R),
+        )
+        rows.append(D.reshape(d, d) - np.eye(d))
+    A = np.vstack(rows)
+    _, s, Vt = np.linalg.svd(A, full_matrices=False)
+    # multiplicity-one: exactly one near-zero singular value
+    if s[-1] > 1e-6 or (len(s) > 1 and s[-2] < 1e-4):
+        raise RuntimeError(
+            f"coupling ({l1},{l2},{l3}): unexpected invariant multiplicity "
+            f"(smallest singular values {s[-3:]})"
+        )
+    C = Vt[-1].reshape(d1, d2, d3)
+    # deterministic sign: first entry with |.| > 0.1*max is positive
+    flat = C.ravel()
+    idx = np.argmax(np.abs(flat) > 0.1 * np.abs(flat).max())
+    if flat[idx] < 0:
+        C = -C
+    C = C * np.sqrt(d3) / np.sqrt((C**2).sum())
+    return np.ascontiguousarray(C)
+
+
+# ---------------------------------------------------------------------------
+# Batched Wigner matrices on device (for eSCN-style edge-frame rotations).
+# ---------------------------------------------------------------------------
+
+def wigner_d_batch(l_max: int, R):
+    """Real Wigner matrices D_l for a batch of rotations R (..., 3, 3).
+
+    Returns {l: (..., 2l+1, 2l+1)}. D_1 equals R itself in this basis
+    (Y_1 = sqrt(3) (x, y, z)); higher l follow by the CG recursion
+    D_l = C^T (D_{l-1} x D_1) C with C = real_clebsch_gordan(l-1, 1, l),
+    whose columns are orthonormal (multiplicity one). Exact and jittable.
+    """
+    import jax.numpy as jnp
+
+    out = {0: jnp.ones(R.shape[:-2] + (1, 1), dtype=R.dtype)}
+    if l_max >= 1:
+        out[1] = R
+    for l in range(2, l_max + 1):
+        C = jnp.asarray(real_clebsch_gordan(l - 1, 1, l), dtype=R.dtype)
+        C = C / np.sqrt(2 * l + 1)  # orthonormal columns
+        out[l] = jnp.einsum(
+            "mnp,...mM,...nN,MNq->...pq", C, out[l - 1], out[1], C
+        ) * (2 * l + 1)
+    return out
+
+
+def rotation_to_z(u):
+    """Batch of rotation matrices R with R @ u = z_hat (..., 3) -> (..., 3, 3).
+
+    Smooth except at u = -z (handled by a stabilized formula). Used to align
+    edge vectors with the z axis for SO(2) convolutions.
+    """
+    import jax.numpy as jnp
+
+    x, y, z = u[..., 0], u[..., 1], u[..., 2]
+    # Rodrigues closed form: R = I + [v]_x + [v]_x^2 / (1 + c) rotates u onto
+    # z, with v = u x z = (y, -x, 0) and c = u . z = z.
+    denom = jnp.maximum(1.0 + z, 1e-6)
+    vx, vy = y, -x
+    zero = jnp.zeros_like(x)
+    K = jnp.stack([
+        jnp.stack([zero, zero, vy], axis=-1),
+        jnp.stack([zero, zero, -vx], axis=-1),
+        jnp.stack([-vy, vx, zero], axis=-1),
+    ], axis=-2)
+    eye = jnp.eye(3, dtype=u.dtype)
+    K2 = jnp.einsum("...ij,...jk->...ik", K, K)
+    R = eye + K + K2 / denom[..., None, None]
+    return R

@@ -81,8 +81,14 @@ def build_plan(
     r: float,
     bond_r: float = 0.0,
     use_bond_graph: bool = False,
+    impl: str = "auto",
 ) -> PartitionPlan:
-    """Partition a neighbor graph into ``num_partitions`` slabs with halos."""
+    """Partition a neighbor graph into ``num_partitions`` slabs with halos.
+
+    impl: "auto" prefers the native C++/OpenMP partitioner and falls back to
+    numpy; "native"/"numpy" force one implementation (tests compare the two
+    for exact equality).
+    """
     lattice = np.asarray(lattice, dtype=np.float64)
     n = nl.wrapped_cart.shape[0]
     P = int(num_partitions)
@@ -97,6 +103,14 @@ def build_plan(
 
     frac = geometry.cart_to_frac(nl.wrapped_cart, lattice)
     walls = make_walls(frac[:, axis], P)
+
+    if impl in ("auto", "native"):
+        plan = _build_plan_native(nl, frac[:, axis], axis, walls, P, use_bond_graph)
+        if plan is not None:
+            return plan
+        if impl == "native":
+            raise PartitionError("native partitioner unavailable")
+
     node_part = which_partition(walls, frac[:, axis])
 
     # --- border classification: src must be visible wherever its edges land ---
@@ -162,6 +176,65 @@ def build_plan(
 
     if use_bond_graph:
         _build_bond_graph(plan, nl)
+    return plan
+
+
+def _build_plan_native(nl, frac_axis, axis, walls, P, use_bond_graph) -> PartitionPlan | None:
+    """Native C++ partitioner path; output layout identical to the numpy
+    oracle (verified exactly in tests/test_partition.py)."""
+    from ..neighbors import native as _native
+
+    try:
+        parts = _native.native_partition(
+            nl.src, nl.dst, frac_axis, walls, P,
+            nl.bond_mask if use_bond_graph else None, use_bond_graph,
+        )
+    except RuntimeError as e:
+        raise PartitionError(str(e)) from e
+    if parts is None:
+        return None
+    if use_bond_graph:
+        W = np.nonzero(nl.bond_mask)[0]
+        if np.any(nl.src[W] == nl.dst[W]):
+            import warnings
+
+            warnings.warn(
+                "Found self-loop edge within bond cutoff (cell smaller than "
+                "bond graph cutoff); line-graph results may be incorrect.",
+                stacklevel=3,
+            )
+    n = nl.wrapped_cart.shape[0]
+    node_part = which_partition(walls, frac_axis)
+    ntp = np.full(n, -1, dtype=np.int64)
+    plan = PartitionPlan(P, axis, walls, node_part, ntp)
+    for p, d in enumerate(parts):
+        plan.global_ids.append(d["global_ids"])
+        plan.node_markers.append(d["node_markers"])
+        g2l = np.full(n, -1, dtype=np.int64)
+        g2l[d["global_ids"]] = np.arange(len(d["global_ids"]))
+        plan.g2l.append(g2l)
+        plan.edge_ids.append(d["edge_ids"])
+        plan.src_local.append(d["src_local"])
+        plan.dst_local.append(d["dst_local"])
+        plan.edge_offsets.append(nl.offsets[d["edge_ids"]])
+        markers = d["node_markers"]
+        for q in range(P):
+            to_ids = d["global_ids"][markers[1 + q]: markers[2 + q]]
+            ntp[to_ids] = q
+    if use_bond_graph:
+        plan.has_bond_graph = True
+        for p, d in enumerate(parts):
+            plan.bond_markers.append(d["bond_markers"])
+            plan.bond_global_edge.append(d["bond_global_edge"])
+            owned_b = int(d["bond_markers"][1 + P])
+            nil = np.zeros(len(d["bond_global_edge"]), dtype=bool)
+            nil[:owned_b] = True
+            plan.bond_needs_in_line.append(nil)
+            plan.line_src.append(d["line_src"])
+            plan.line_dst.append(d["line_dst"])
+            plan.line_center_local.append(d["line_center"])
+            plan.bond_mapping_edge.append(d["bm_edge"])
+            plan.bond_mapping_bond.append(d["bm_bond"])
     return plan
 
 

@@ -1,0 +1,4 @@
+from .checkpoint import save_params, load_params
+from .profiling import StepTimer, device_trace
+
+__all__ = ["save_params", "load_params", "StepTimer", "device_trace"]

@@ -1,0 +1,71 @@
+"""Parameter checkpointing: save/load arbitrary parameter pytrees.
+
+The reference has no checkpointing of its own (model state comes from
+upstream loaders, SURVEY.md §5); here it is first-class since this framework
+also trains. Zero-dependency format: npz with slash-joined tree paths, so
+checkpoints are portable and inspectable (np.load). Orbax can be layered on
+later for multi-host async checkpointing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _flatten_with_paths(tree, prefix=""):
+    import jax
+
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_with_paths(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_with_paths(v, f"{prefix}{i}/"))
+    else:
+        out[prefix[:-1]] = np.asarray(jax.device_get(tree))
+    return out
+
+
+def save_params(path: str, params) -> None:
+    flat = _flatten_with_paths(params)
+    np.savez_compressed(path, **flat)
+
+
+def load_params(path: str, like=None):
+    """Load a checkpoint; if ``like`` (a template pytree) is given, restore
+    the exact tree structure (lists vs dicts) and dtypes."""
+    data = dict(np.load(path, allow_pickle=False))
+
+    if like is None:
+        # rebuild nested dicts; integer keys become dicts too
+        root: dict = {}
+        for key, val in data.items():
+            parts = key.split("/")
+            node = root
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = val
+        return root
+
+    import jax
+
+    def rebuild(template, prefix=""):
+        if isinstance(template, dict):
+            return {k: rebuild(v, f"{prefix}{k}/") for k, v in template.items()}
+        if isinstance(template, (list, tuple)):
+            seq = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(template)]
+            return type(template)(seq) if isinstance(template, tuple) else seq
+        key = prefix[:-1]
+        if key not in data:
+            raise KeyError(f"checkpoint missing parameter {key!r}")
+        arr = data[key]
+        t = jax.device_get(template)
+        if np.shape(t) != arr.shape:
+            raise ValueError(
+                f"shape mismatch for {key!r}: checkpoint {arr.shape} vs "
+                f"template {np.shape(t)}"
+            )
+        return arr.astype(np.asarray(t).dtype)
+
+    return rebuild(like)

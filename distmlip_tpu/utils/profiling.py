@@ -1,0 +1,55 @@
+"""Profiling / tracing utilities.
+
+Reference analogues: C TIMING macros + torch.profiler ranges (SURVEY.md §5).
+Here: jax.profiler traces for device timelines plus a lightweight host-side
+step timer that aggregates the per-phase breakdown DistPotential records.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """jax.profiler trace context; view with tensorboard or xprof."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Aggregates named phase timings across steps; prints a summary."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def add(self, timings: dict[str, float]):
+        for k, v in timings.items():
+            self.totals[k] += v
+            self.counts[k] += 1
+
+    def summary(self) -> str:
+        lines = ["phase                    total_s   mean_ms  calls"]
+        for k in sorted(self.totals, key=self.totals.get, reverse=True):
+            n = max(self.counts[k], 1)
+            lines.append(
+                f"{k:<24} {self.totals[k]:8.3f} {1e3 * self.totals[k] / n:9.2f} {n:6d}"
+            )
+        return "\n".join(lines)

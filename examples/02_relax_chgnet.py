@@ -1,0 +1,22 @@
+"""Structure relaxation (positions + cell) with distributed CHGNet."""
+
+import jax
+import numpy as np
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import Atoms, DistPotential, Relaxer
+from distmlip_tpu.models import CHGNet, CHGNetConfig
+
+rng = np.random.default_rng(1)
+unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.6, (6, 6, 6))
+cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, 0.08, (len(frac), 3))
+atoms = Atoms(numbers=np.full(len(cart), 3), positions=cart, cell=lattice * 1.02)
+
+model = CHGNet(CHGNetConfig(cutoff=5.0, bond_cutoff=3.0))
+params = model.init(jax.random.PRNGKey(0))
+pot = DistPotential(model, params, skin=0.4)
+
+out = Relaxer(pot, optimizer="fire", relax_cell=True).relax(atoms, steps=300)
+print(f"converged={out.converged} steps={out.nsteps} E={out.energy:.4f} eV "
+      f"|F|max={np.abs(out.forces).max():.4f}")

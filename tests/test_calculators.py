@@ -107,3 +107,64 @@ def test_relaxer_with_cell(rng, potential):
     # stress reduced vs initial
     res0 = potential.calculate(atoms)
     assert np.abs(out.stress).max() <= np.abs(res0["stress"]).max() + 1e-6
+
+
+def test_skin_reuse_exact_and_invalidation(rng):
+    """skin>0: cache-hit results match rebuild-every-step exactly; cache
+    invalidates on displacement > skin/2, cell change, and species change."""
+    model = PairPotential(PairConfig(cutoff=3.0, kind="lj"))
+    params = {"eps": np.float32(0.1), "sigma": np.float32(2.0)}
+    atoms = make_atoms(rng, reps=(4, 3, 3))
+    pot0 = DistPotential(model, params, num_partitions=2, skin=0.0)
+    pot1 = DistPotential(model, params, num_partitions=2, skin=0.6)
+    pos = atoms.positions.copy()
+    for _ in range(6):
+        pos += rng.normal(0, 0.01, pos.shape)
+        a = Atoms(numbers=atoms.numbers, positions=pos, cell=atoms.cell)
+        r0 = pot0.calculate(a)
+        r1 = pot1.calculate(a)
+        assert abs(r0["energy"] - r1["energy"]) < 1e-4
+        np.testing.assert_allclose(r0["forces"], r1["forces"], atol=1e-5)
+        np.testing.assert_allclose(r0["stress"], r1["stress"], atol=1e-6)
+    assert pot1.rebuild_count == 1 and pot0.rebuild_count == 6
+
+    # displacement invalidation: move one atom by > skin/2
+    pos2 = pos.copy()
+    pos2[0] += [0.4, 0, 0]
+    pot1.calculate(Atoms(numbers=atoms.numbers, positions=pos2, cell=atoms.cell))
+    assert pot1.rebuild_count == 2
+
+    # cell invalidation: tiny (1e-5 relative) cell change must rebuild
+    pot1.calculate(Atoms(numbers=atoms.numbers, positions=pos2,
+                         cell=atoms.cell * (1 + 1e-5)))
+    assert pot1.rebuild_count == 3
+
+
+def test_npt_requires_stress(rng):
+    model = PairPotential(PairConfig(cutoff=3.0))
+    pot = DistPotential(model, {"eps": np.float32(0.1), "sigma": np.float32(2.0)},
+                        num_partitions=1, compute_stress=False)
+    atoms = make_atoms(rng, reps=(2, 2, 2))
+    with pytest.raises(ValueError, match="compute_stress"):
+        MolecularDynamics(atoms, pot, ensemble="npt_berendsen")
+
+
+def test_ensemble_potential(rng):
+    model = PairPotential(PairConfig(cutoff=3.0))
+    from distmlip_tpu.calculators import EnsemblePotential
+
+    plist = [{"eps": np.float32(0.1 * (1 + 0.1 * i)), "sigma": np.float32(2.0)}
+             for i in range(3)]
+    ens = EnsemblePotential(model, plist, num_partitions=2)
+    atoms = make_atoms(rng, reps=(2, 2, 2))
+    res = ens.calculate(atoms)
+    assert res["energies"].shape == (3,)
+    assert res["energy_var"] > 0
+    assert res["forces"].shape == (len(atoms), 3)
+    np.testing.assert_allclose(res["energy"], res["energies"].mean())
+
+
+def test_relaxer_lbfgs(rng, potential):
+    atoms = make_atoms(rng, noise=0.12)
+    out = Relaxer(potential, optimizer="lbfgs", fmax=0.05).relax(atoms, steps=200)
+    assert out.converged and np.abs(out.forces).max() < 0.05

@@ -149,3 +149,57 @@ def test_bond_mapping(rng, P):
         local_edge_global = plan.edge_ids[p][plan.bond_mapping_edge[p]]
         bond_global = plan.bond_global_edge[p][plan.bond_mapping_bond[p]]
         np.testing.assert_array_equal(local_edge_global, bond_global)
+
+
+@pytest.mark.parametrize("P", [2, 4])
+@pytest.mark.parametrize("bond", [False, True])
+def test_native_matches_numpy_oracle(rng, P, bond):
+    """The C++ partitioner must reproduce the numpy plan EXACTLY."""
+    from distmlip_tpu.neighbors.native import native_available
+    from distmlip_tpu.neighbors import neighbor_list_numpy
+
+    if not native_available():
+        pytest.skip("native lib unavailable")
+    box = max(16.0, P * 8.0)
+    cart, lattice, _, pbc = random_cell(rng, n_atoms=int(0.02 * box**3), box=box)
+    nl = neighbor_list_numpy(cart, lattice, pbc, R, bond_r=BOND_R)
+    p_np = build_plan(nl, lattice, pbc, P, R, BOND_R, bond, impl="numpy")
+    p_nat = build_plan(nl, lattice, pbc, P, R, BOND_R, bond, impl="native")
+    for p in range(P):
+        np.testing.assert_array_equal(p_np.global_ids[p], p_nat.global_ids[p])
+        np.testing.assert_array_equal(p_np.node_markers[p], p_nat.node_markers[p])
+        np.testing.assert_array_equal(p_np.edge_ids[p], p_nat.edge_ids[p])
+        np.testing.assert_array_equal(p_np.src_local[p], p_nat.src_local[p])
+        np.testing.assert_array_equal(p_np.dst_local[p], p_nat.dst_local[p])
+        if bond:
+            np.testing.assert_array_equal(p_np.bond_markers[p], p_nat.bond_markers[p])
+            np.testing.assert_array_equal(
+                p_np.bond_global_edge[p], p_nat.bond_global_edge[p])
+            np.testing.assert_array_equal(p_np.line_src[p], p_nat.line_src[p])
+            np.testing.assert_array_equal(p_np.line_dst[p], p_nat.line_dst[p])
+            np.testing.assert_array_equal(
+                p_np.line_center_local[p], p_nat.line_center_local[p])
+            np.testing.assert_array_equal(
+                p_np.bond_mapping_edge[p], p_nat.bond_mapping_edge[p])
+            np.testing.assert_array_equal(
+                p_np.bond_mapping_bond[p], p_nat.bond_mapping_bond[p])
+    np.testing.assert_array_equal(p_np.nodes_to_partition, p_nat.nodes_to_partition)
+
+
+def test_native_partitioner_rejects_multidest(rng):
+    from distmlip_tpu.neighbors.native import native_available
+    from distmlip_tpu.neighbors import neighbor_list_numpy
+
+    if not native_available():
+        pytest.skip("native lib unavailable")
+    cart, lattice, _, pbc = random_cell(rng, n_atoms=200, box=16.0)
+    nl = neighbor_list_numpy(cart, lattice, pbc, R)
+    # P=4 on a 16 A box: slab 4 A > R so check_partition_size passes, but
+    # nodes reach both sides (width < 2R) -> both impls must raise
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(PartitionError):
+            build_plan(nl, lattice, pbc, 4, R, impl="native")
+        with pytest.raises(PartitionError):
+            build_plan(nl, lattice, pbc, 4, R, impl="numpy")

@@ -1,0 +1,77 @@
+"""SO(3) math: spherical-harmonic normalization/equivariance, CG equivariance."""
+
+import numpy as np
+import pytest
+
+from distmlip_tpu.ops import so3
+
+
+def random_rotation(rng):
+    q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    return q
+
+
+@pytest.mark.parametrize("l", [0, 1, 2, 3])
+def test_sh_component_normalization(rng, l):
+    """E[|Y_l|^2] over the sphere = 2l+1 for component normalization."""
+    u = rng.normal(size=(20000, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    Y = np.asarray(so3.spherical_harmonics(l, u))
+    mean_sq = (Y**2).sum(axis=1).mean()
+    np.testing.assert_allclose(mean_sq, 2 * l + 1, rtol=0.05)
+
+
+@pytest.mark.parametrize("l", [1, 2, 3])
+def test_sh_equivariance(rng, l):
+    """Y_l(R u) = D_l(R) Y_l(u) with an orthogonal D."""
+    R = random_rotation(rng)
+    D = so3.wigner_d_from_rotation(l, R)
+    # D orthogonal
+    np.testing.assert_allclose(D @ D.T, np.eye(2 * l + 1), atol=1e-5)
+    u = rng.normal(size=(50, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    Y = np.asarray(so3.spherical_harmonics(l, u))
+    Yr = np.asarray(so3.spherical_harmonics(l, u @ R.T))
+    np.testing.assert_allclose(Yr, Y @ D.T, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "l1,l2,l3",
+    [(1, 1, 0), (1, 1, 1), (1, 1, 2), (2, 1, 1), (2, 2, 2), (3, 2, 1),
+     (2, 1, 3), (3, 3, 0), (1, 2, 3)],
+)
+def test_cg_equivariance(rng, l1, l2, l3):
+    """C must be an invariant tensor of D_l1 x D_l2 x D_l3."""
+    C = so3.real_clebsch_gordan(l1, l2, l3)
+    assert C is not None
+    R = random_rotation(rng)
+    D1 = so3.wigner_d_from_rotation(l1, R)
+    D2 = so3.wigner_d_from_rotation(l2, R)
+    D3 = so3.wigner_d_from_rotation(l3, R)
+    inv = np.einsum("xa,yb,zc,abc->xyz", D1, D2, D3, C)
+    np.testing.assert_allclose(inv, C, atol=1e-5)
+
+
+def test_cg_triangle_violation():
+    assert so3.real_clebsch_gordan(1, 1, 3) is None
+
+
+def test_cg_11_1_is_cross_product():
+    """The 1x1->1 coupling is the Levi-Civita tensor up to scale."""
+    C = so3.real_clebsch_gordan(1, 1, 1)
+    eps = np.zeros((3, 3, 3))
+    for i, j, k in [(0, 1, 2), (1, 2, 0), (2, 0, 1)]:
+        eps[i, j, k] = 1.0
+        eps[j, i, k] = -1.0
+    # both are antisymmetric invariant tensors -> proportional
+    ratio = C[np.abs(eps) > 0] / eps[np.abs(eps) > 0]
+    np.testing.assert_allclose(ratio, ratio[0], atol=1e-9)
+
+
+def test_sh_stack_shape(rng):
+    u = rng.normal(size=(7, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    Y = so3.spherical_harmonics_stack(3, u)
+    assert Y.shape == (7, 16)

@@ -1,0 +1,64 @@
+"""Aux subsystems: checkpoint save/load, profiling, torch conversion machinery."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distmlip_tpu.models import TensorNet, TensorNetConfig
+from distmlip_tpu.models.convert import Rule, convert
+from distmlip_tpu.utils.checkpoint import load_params, save_params
+from distmlip_tpu.utils.profiling import StepTimer
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    model = TensorNet(TensorNetConfig(num_species=4, units=8, num_rbf=4, num_layers=1))
+    params = model.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt.npz")
+    save_params(path, params)
+    restored = load_params(path, like=params)
+    leaves1 = jax.tree.leaves(params)
+    leaves2 = jax.tree.leaves(restored)
+    assert len(leaves1) == len(leaves2)
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # structure preserved (lists stay lists)
+    assert isinstance(restored["layers"], list)
+
+
+def test_checkpoint_shape_mismatch(tmp_path):
+    model = TensorNet(TensorNetConfig(num_species=4, units=8, num_rbf=4, num_layers=1))
+    params = model.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt.npz")
+    save_params(path, params)
+    other = TensorNet(TensorNetConfig(num_species=4, units=16, num_rbf=4, num_layers=1))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_params(path, like=other.init(jax.random.PRNGKey(0)))
+
+
+def test_convert_rules():
+    params = {"lin": {"w": np.zeros((3, 2)), "b": np.zeros(2)}}
+    sd = {"layer.weight": np.arange(6.0).reshape(2, 3), "layer.bias": np.ones(2)}
+    out, report = convert(
+        sd, params,
+        [Rule("layer.weight", ("lin", "w"), lambda a: a.T),
+         Rule("layer.bias", ("lin", "b"))],
+    )
+    np.testing.assert_allclose(out["lin"]["w"], np.arange(6.0).reshape(2, 3).T)
+    assert report["mapped"] == 2 and not report["unused_torch"]
+
+
+def test_convert_strict_unused():
+    params = {"lin": {"w": np.zeros((1, 1))}}
+    sd = {"a.weight": np.zeros((1, 1)), "extra": np.zeros(3)}
+    with pytest.raises(ValueError, match="unmapped"):
+        convert(sd, params, [Rule("a.weight", ("lin", "w"), lambda a: a.T)])
+
+
+def test_step_timer():
+    t = StepTimer()
+    with t.phase("x"):
+        pass
+    t.add({"y": 0.5})
+    s = t.summary()
+    assert "x" in s and "y" in s

@@ -157,7 +157,8 @@ class CHGNet:
         """Gated message passing on the atom graph (owner-computes on dst)."""
         feats = jnp.concatenate([v[lg.edge_src], v[lg.edge_dst], e], axis=-1)
         m = gated_mlp(blk["atom_conv"], feats) * env[:, None]
-        agg = masked_segment_sum(m, lg.edge_dst, lg.n_cap, lg.edge_mask)
+        agg = masked_segment_sum(m, lg.edge_dst, lg.n_cap, lg.edge_mask,
+                                 indices_are_sorted=True)
         v = v + layernorm(blk["atom_ln"], agg)
         return v, e
 
@@ -173,7 +174,8 @@ class CHGNet:
             [b[lg.line_src], b[lg.line_dst], a, v[lg.line_center]], axis=-1
         )
         m = gated_mlp(blk["bond_conv"], feats) * line_w[:, None]
-        agg = masked_segment_sum(m, lg.line_dst, lg.b_cap, lg.line_mask)
+        agg = masked_segment_sum(m, lg.line_dst, lg.b_cap, lg.line_mask,
+                                 indices_are_sorted=True)
         b = b + layernorm(blk["bond_ln"], agg)
 
         # angle update from the refreshed bond features

@@ -11,7 +11,7 @@ Wigner matrices are built on-device by the exact CG recursion
 layer loop is one SPMD program.
 
 Node features: h (N, C, S) — S = (l_max+1)^2 stacked real spherical-harmonic
-coefficients (l <= 3 until the SH table grows). Each edge: rotate the sender
+coefficients (l <= 6). Each edge: rotate the sender
 features into the edge-aligned frame (edge direction -> z), run SO(2)
 convolutions (per-|m| channel-mixing linear maps with the (+m, -m) complex
 pair structure, which commutes with rotations about z), rotate back,
@@ -41,7 +41,7 @@ from ..ops.so3 import rotation_to_z, spherical_harmonics_stack, wigner_d_batch
 class ESCNConfig:
     num_species: int = 95
     channels: int = 64
-    l_max: int = 2              # <= 3
+    l_max: int = 2              # <= 6 (SH table limit)
     num_layers: int = 3
     num_bessel: int = 8
     num_experts: int = 1        # > 1 enables UMA-style MOLE weight mixing
@@ -80,8 +80,8 @@ def _m_index(l_max):
 
 class ESCN:
     def __init__(self, config: ESCNConfig = ESCNConfig()):
-        if config.l_max > 3:
-            raise NotImplementedError("l_max > 3 needs the SH table extended")
+        if config.l_max > 6:
+            raise NotImplementedError("l_max > 6: extend ops/so3 normalizations")
         self.cfg = config
         self.m_idx = _m_index(config.l_max)
 
@@ -199,7 +199,8 @@ class ESCN:
                     y = y.at[:, :, minus].set(ym.reshape(-1, C, nl))
 
             msg = rotate(y, transpose=True) * env[:, None, None]
-            agg = masked_segment_sum(msg, lg.edge_dst, lg.n_cap, lg.edge_mask)
+            agg = masked_segment_sum(msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
+                                     indices_are_sorted=True)
             agg = agg * inv_avg
 
             # gated nonlinearity: scalars via MLP, higher l scaled by gates

@@ -259,8 +259,15 @@ class MACE:
             widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
             return jnp.pad(x, widths, constant_values=fill)
 
-        src_ch = pad_c(lg.edge_src).reshape(K, chunk)
-        dst_ch = pad_c(lg.edge_dst).reshape(K, chunk)
+        def pad_edge(x):
+            # pad with the last element: dst stays sorted for the
+            # indices_are_sorted segment-sum fast path (padding is masked)
+            if pad == 0:
+                return x
+            return jnp.concatenate([x, jnp.broadcast_to(x[-1], (pad,))])
+
+        src_ch = pad_edge(lg.edge_src).reshape(K, chunk)
+        dst_ch = pad_edge(lg.edge_dst).reshape(K, chunk)
         mask_ch = pad_c(lg.edge_mask).reshape(K, chunk)
         env_ch = pad_c(env).reshape(K, chunk)
         bes_ch = pad_c(bessel).reshape(K, chunk, -1)
@@ -277,7 +284,7 @@ class MACE:
                     "ecm,en,mnp->ecp", hu[lh][srcc], Yc[ly], cgt
                 ) * Rc[:, pi, :, None]
                 A_acc[lo] = A_acc[lo] + masked_segment_sum(
-                    m, dstc, A_acc[lo].shape[0], maskc
+                    m, dstc, A_acc[lo].shape[0], maskc, indices_are_sorted=True
                 )
             return A_acc, None
 

@@ -42,4 +42,5 @@ class PairPotential:
             e_edge = params["D"] * (ex * ex - 2.0 * ex)
         e_edge = jnp.where(lg.edge_mask, e_edge * env, 0.0)
         # half: every pair appears as two directed edges
-        return 0.5 * masked_segment_sum(e_edge[:, None], lg.edge_dst, lg.n_cap)[:, 0]
+        return 0.5 * masked_segment_sum(e_edge[:, None], lg.edge_dst, lg.n_cap,
+                                        indices_are_sorted=True)[:, 0]

@@ -143,7 +143,8 @@ class TensorNet:
             [jnp.broadcast_to(eye, A_e.shape), A_e, S_e], axis=1
         )                                                 # (E, 3, 3, 3)
         edge_X = jnp.einsum("ekc,ekij->ecij", w, comps)   # (E, C, 3, 3)
-        X = masked_segment_sum(edge_X, lg.edge_dst, lg.n_cap, lg.edge_mask)
+        X = masked_segment_sum(edge_X, lg.edge_dst, lg.n_cap, lg.edge_mask,
+                               indices_are_sorted=True)
 
         X = self._normalize_mix(params["emb_norm_mlp"], X, params["emb_ln"])
         X = lg.halo_exchange(X)
@@ -197,7 +198,8 @@ class TensorNet:
             + f[:, 1, :, None, None] * A_j
             + f[:, 2, :, None, None] * S_j
         )
-        Y = masked_segment_sum(M, lg.edge_dst, lg.n_cap, lg.edge_mask)
+        Y = masked_segment_sum(M, lg.edge_dst, lg.n_cap, lg.edge_mask,
+                               indices_are_sorted=True)
 
         # matrix-polynomial node update
         Y2 = jnp.einsum("...ij,...jk->...ik", Y, Y)

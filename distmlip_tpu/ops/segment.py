@@ -11,12 +11,19 @@ import jax
 import jax.numpy as jnp
 
 
-def masked_segment_sum(data, segment_ids, num_segments: int, mask=None):
-    """segment_sum with an optional validity mask on the data rows."""
+def masked_segment_sum(data, segment_ids, num_segments: int, mask=None,
+                       indices_are_sorted: bool = False):
+    """segment_sum with an optional validity mask on the data rows.
+
+    Graph edge/line arrays are emitted dst-sorted by the partition builder,
+    so callers aggregating over full edge arrays pass
+    ``indices_are_sorted=True`` (TPU scatter fast path).
+    """
     if mask is not None:
         m = mask.astype(data.dtype)
         data = data * m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
-    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments,
+                               indices_are_sorted=indices_are_sorted)
 
 
 def masked_segment_mean(data, segment_ids, num_segments: int, mask=None, eps=1e-12):

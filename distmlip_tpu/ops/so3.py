@@ -1,8 +1,10 @@
 """SO(3) machinery: real spherical harmonics and real coupling tensors.
 
 Self-contained replacement for the e3nn pieces MACE-style equivariant models
-need (no e3nn-jax in this image): hardcoded real spherical harmonics up to
-l=3 (component normalization, ||Y_l||^2 = 2l+1, matching e3nn's default) and
+need (no e3nn-jax in this image): real spherical harmonics with component
+normalization (||Y_l||^2 = 2l+1) — hardcoded e3nn-convention tables for
+l <= 3, a Cartesian-recurrence construction for any higher l (each l's basis
+is independent, so mixed conventions are safe within this stack) — and
 real-basis Clebsch-Gordan coupling tensors, cached per (l1, l2, l3).
 
 The coupling tensor for (l1, l2, l3) is constructed numerically as the
@@ -60,7 +62,64 @@ def _sh_impl(l: int, u, xp):
             ],
             axis=-1,
         )
-    raise NotImplementedError(f"l={l} > 3")
+    return _sh_general(l, u, xp)
+
+
+def _sh_general(l: int, u, xp):
+    """Real spherical harmonics for any l via Cartesian recurrences.
+
+    Basis convention per l is independent (any orthogonal basis of the
+    degree-l harmonics works — the coupling tensors are constructed from
+    THESE functions, so the stack stays self-consistent); l <= 3 keeps the
+    hardcoded e3nn-convention tables above.
+
+    Construction (all polynomial in x, y, z — smooth at the poles):
+      A_m + i B_m = (x + i y)^m;  Pi_l^m(z) = P_l^m with (1-z^2)^{m/2}
+      removed; Y_{l, +-m} = N_{l,m} Pi_l^m(z) {A_m, B_m}; component
+      normalization E[|Y_lm|^2] = 1 over the sphere.
+    """
+    from math import factorial
+
+    x, y, z = u[..., 0], u[..., 1], u[..., 2]
+    # A_m + i B_m = (x + i y)^m
+    A = [xp.ones_like(x)]
+    B = [xp.zeros_like(x)]
+    for m in range(1, l + 1):
+        a_new = A[m - 1] * x - B[m - 1] * y
+        b_new = A[m - 1] * y + B[m - 1] * x
+        A.append(a_new)
+        B.append(b_new)
+
+    # Pi_l^m(z): stable upward recurrence
+    # Pi_m^m = (2m-1)!!; Pi_{m+1}^m = z (2m+1) Pi_m^m
+    # (l-m) Pi_l^m = (2l-1) z Pi_{l-1}^m - (l+m-1) Pi_{l-2}^m
+    Pi = {}
+    for m in range(0, l + 1):
+        dfact = 1.0
+        for k in range(1, 2 * m, 2):
+            dfact *= k
+        Pi[(m, m)] = dfact * xp.ones_like(x)
+        if l >= m + 1:
+            Pi[(m + 1, m)] = z * (2 * m + 1) * Pi[(m, m)]
+        for ll in range(m + 2, l + 1):
+            Pi[(ll, m)] = (
+                (2 * ll - 1) * z * Pi[(ll - 1, m)] - (ll + m - 1) * Pi[(ll - 2, m)]
+            ) / (ll - m)
+
+    comps = []
+    for m in range(-l, l + 1):
+        am = abs(m)
+        # component normalization: E[|Y|^2] = 1 -> N^2 * E[Pi^2 rxy^(2m) trig^2]
+        norm = np.sqrt(
+            (2 * l + 1) * factorial(l - am) / factorial(l + am)
+        ) * (np.sqrt(2.0) if am > 0 else 1.0)
+        if m < 0:
+            comps.append(norm * Pi[(l, am)] * B[am])
+        elif m == 0:
+            comps.append(norm * Pi[(l, 0)])
+        else:
+            comps.append(norm * Pi[(l, am)] * A[am])
+    return xp.stack(comps, axis=-1)
 
 
 def spherical_harmonics(l: int, u):

@@ -180,6 +180,9 @@ def build_partitioned_graph(
     # reported relative to it, so MD positions drift out of the box freely
     input_cart = nl.wrapped_cart + nl.shift @ np.asarray(lattice, dtype=np.float64)
     owned_counts = plan.owned_counts
+    # per-partition edges sorted by dst so segment reductions see sorted
+    # indices (TPU-friendly); bond_map edge indices are remapped to match
+    edge_perm_inv = []
     for p in range(P):
         g = plan.global_ids[p]
         nt = len(g)
@@ -188,9 +191,17 @@ def build_partitioned_graph(
         node_mask[p, :nt] = True
         owned_mask[p, : owned_counts[p]] = True
         ne = len(plan.edge_ids[p])
-        edge_src[p, :ne] = plan.src_local[p]
-        edge_dst[p, :ne] = plan.dst_local[p]
-        edge_offset[p, :ne] = plan.edge_offsets[p]
+        perm = np.argsort(plan.dst_local[p], kind="stable")
+        inv = np.empty(ne, dtype=np.int64)
+        inv[perm] = np.arange(ne)
+        edge_perm_inv.append(inv)
+        edge_src[p, :ne] = plan.src_local[p][perm]
+        edge_dst[p, :ne] = plan.dst_local[p][perm]
+        # pad dst with the last real value: keeps the array sorted for the
+        # segment-sum fast path, stays in-bounds for eager gathers; masked
+        # messages are zeroed so the extra segment contributions are 0
+        edge_dst[p, ne:] = plan.dst_local[p][perm][-1] if ne else 0
+        edge_offset[p, :ne] = plan.edge_offsets[p][perm]
         edge_mask[p, :ne] = True
 
     shifts, h_send, h_smask, h_recv = _halo_tables(plan, plan.section, n_cap, caps, "halo")
@@ -207,13 +218,16 @@ def build_partitioned_graph(
         bm_bond = np.zeros((P, m_cap), dtype=np.int32)
         bm_mask = np.zeros((P, m_cap), dtype=bool)
         for p in range(P):
+            # line edges sorted by dst bond node for sorted segment sums
+            lperm = np.argsort(plan.line_dst[p], kind="stable")
             nl_p = len(plan.line_src[p])
-            line_src[p, :nl_p] = plan.line_src[p]
-            line_dst[p, :nl_p] = plan.line_dst[p]
-            line_center[p, :nl_p] = plan.line_center_local[p]
+            line_src[p, :nl_p] = plan.line_src[p][lperm]
+            line_dst[p, :nl_p] = plan.line_dst[p][lperm]
+            line_dst[p, nl_p:] = plan.line_dst[p][lperm][-1] if nl_p else 0
+            line_center[p, :nl_p] = plan.line_center_local[p][lperm]
             line_mask[p, :nl_p] = True
             nm = len(plan.bond_mapping_edge[p])
-            bm_edge[p, :nm] = plan.bond_mapping_edge[p]
+            bm_edge[p, :nm] = edge_perm_inv[p][plan.bond_mapping_edge[p]]
             bm_bond[p, :nm] = plan.bond_mapping_bond[p]
             bm_mask[p, :nm] = True
         b_shifts, b_send, b_smask, b_recv = _halo_tables(

@@ -75,3 +75,41 @@ def test_sh_stack_shape(rng):
     u /= np.linalg.norm(u, axis=1, keepdims=True)
     Y = so3.spherical_harmonics_stack(3, u)
     assert Y.shape == (7, 16)
+
+
+@pytest.mark.parametrize("l", [4, 5, 6])
+def test_sh_general_normalization_and_equivariance(rng, l):
+    """Recurrence-based SH (l >= 4): normalization + orthogonal Wigner."""
+    u = rng.normal(size=(20000, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    Y = so3.spherical_harmonics_np(l, u)
+    np.testing.assert_allclose((Y**2).sum(axis=1).mean(), 2 * l + 1, rtol=0.05)
+    R = random_rotation(rng)
+    D = so3.wigner_d_from_rotation(l, R)
+    np.testing.assert_allclose(D @ D.T, np.eye(2 * l + 1), atol=1e-9)
+    u2 = u[:40]
+    Yr = so3.spherical_harmonics_np(l, u2 @ R.T)
+    np.testing.assert_allclose(Yr, so3.spherical_harmonics_np(l, u2) @ D.T, atol=1e-9)
+
+
+def test_cg_high_l(rng):
+    C = so3.real_clebsch_gordan(4, 2, 6)
+    assert C is not None and C.shape == (9, 5, 13)
+    R = random_rotation(rng)
+    inv = np.einsum(
+        "xa,yb,zc,abc->xyz",
+        so3.wigner_d_from_rotation(4, R),
+        so3.wigner_d_from_rotation(2, R),
+        so3.wigner_d_from_rotation(6, R),
+        C,
+    )
+    np.testing.assert_allclose(inv, C, atol=1e-8)
+
+
+def test_wigner_d_batch_high_l(rng):
+    import jax.numpy as jnp
+
+    R = random_rotation(rng)
+    D = so3.wigner_d_batch(4, jnp.asarray(R[None].astype(np.float32)))
+    Dref = so3.wigner_d_from_rotation(4, R)
+    np.testing.assert_allclose(np.asarray(D[4][0]), Dref, atol=1e-5)

@@ -34,10 +34,10 @@ def _sh_impl(l: int, u, xp):
     if l == 0:
         return xp.ones(u.shape[:-1] + (1,), dtype=u.dtype)
     if l == 1:
-        s3 = np.sqrt(3.0)
+        s3 = float(np.sqrt(3.0))  # python floats stay weak-typed (bf16-safe)
         return xp.stack([s3 * x, s3 * y, s3 * z], axis=-1)
     if l == 2:
-        s15, s5 = np.sqrt(15.0), np.sqrt(5.0)
+        s15, s5 = float(np.sqrt(15.0)), float(np.sqrt(5.0))
         return xp.stack(
             [
                 s15 * x * y,
@@ -49,7 +49,7 @@ def _sh_impl(l: int, u, xp):
             axis=-1,
         )
     if l == 3:
-        s = np.sqrt
+        s = lambda v: float(np.sqrt(v))
         return xp.stack(
             [
                 s(35.0 / 8.0) * y * (3 * x * x - y * y),
@@ -110,9 +110,10 @@ def _sh_general(l: int, u, xp):
     for m in range(-l, l + 1):
         am = abs(m)
         # component normalization: E[|Y|^2] = 1 -> N^2 * E[Pi^2 rxy^(2m) trig^2]
-        norm = np.sqrt(
-            (2 * l + 1) * factorial(l - am) / factorial(l + am)
-        ) * (np.sqrt(2.0) if am > 0 else 1.0)
+        norm = float(
+            np.sqrt((2 * l + 1) * factorial(l - am) / factorial(l + am))
+            * (np.sqrt(2.0) if am > 0 else 1.0)
+        )
         if m < 0:
             comps.append(norm * Pi[(l, am)] * B[am])
         elif m == 0:

@@ -56,9 +56,9 @@ class LocalGraph:
     node_mask: Any
     owned_mask: Any
     edge_src: Any
-    edge_dst: Any
-    edge_offset: Any
-    edge_mask: Any
+    edge_dst: Any       # CONTRACT: nondecreasing (models rely on
+    edge_offset: Any    # indices_are_sorted=True segment sums); same for
+    edge_mask: Any      # line_dst — established by build_partitioned_graph
     halo_send_idx: Any
     halo_send_mask: Any
     halo_recv_idx: Any

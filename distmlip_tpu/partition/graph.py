@@ -203,6 +203,7 @@ def build_partitioned_graph(
         edge_dst[p, ne:] = plan.dst_local[p][perm][-1] if ne else 0
         edge_offset[p, :ne] = plan.edge_offsets[p][perm]
         edge_mask[p, :ne] = True
+        assert np.all(np.diff(edge_dst[p]) >= 0), "edge_dst must be sorted"
 
     shifts, h_send, h_smask, h_recv = _halo_tables(plan, plan.section, n_cap, caps, "halo")
 
@@ -226,6 +227,7 @@ def build_partitioned_graph(
             line_dst[p, nl_p:] = plan.line_dst[p][lperm][-1] if nl_p else 0
             line_center[p, :nl_p] = plan.line_center_local[p][lperm]
             line_mask[p, :nl_p] = True
+            assert np.all(np.diff(line_dst[p]) >= 0), "line_dst must be sorted"
             nm = len(plan.bond_mapping_edge[p])
             bm_edge[p, :nm] = edge_perm_inv[p][plan.bond_mapping_edge[p]]
             bm_bond[p, :nm] = plan.bond_mapping_bond[p]

@@ -1,8 +1,12 @@
 """Masked segment reductions for padded graphs.
 
 All graph aggregation in the framework goes through these: messages on
-padded (invalid) edges are zeroed by the mask and scattered to row 0, so
-static-shape padding never corrupts results.
+padded (invalid) edges are zeroed by the mask, so static-shape padding never
+corrupts results. Padding contract (established by
+partition/graph.py:build_partitioned_graph): padded ``dst``/``segment_ids``
+rows repeat the LAST REAL value — keeping the index arrays nondecreasing for
+the ``indices_are_sorted=True`` fast path and in-bounds for eager gathers —
+never 0 and never ``num_segments``.
 """
 
 from __future__ import annotations

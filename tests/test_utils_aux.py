@@ -62,3 +62,30 @@ def test_step_timer():
     t.add({"y": 0.5})
     s = t.summary()
     assert "x" in s and "y" in s
+
+
+def test_checkpoint_none_leaves_roundtrip(tmp_path):
+    """None leaves (empty subtrees, e.g. ESCN mole_gate with 1 expert) must
+    round-trip without pickled object arrays (ADVICE r1)."""
+    params = {"a": {"w": np.ones((2, 2))}, "gate": None,
+              "layers": [{"w": np.zeros(3), "opt": None}]}
+    path = str(tmp_path / "ckpt_none.npz")
+    save_params(path, params)
+    restored = load_params(path, like=params)
+    assert restored["gate"] is None
+    assert restored["layers"][0]["opt"] is None
+    np.testing.assert_allclose(restored["a"]["w"], params["a"]["w"])
+
+
+def test_checkpoint_escn_roundtrip(tmp_path):
+    """Full ESCN params (num_experts=1 -> mole_gate=None) round-trip."""
+    from distmlip_tpu.models import ESCN, ESCNConfig
+
+    model = ESCN(ESCNConfig(num_species=3, channels=8, l_max=1, num_layers=1,
+                            num_bessel=4, num_experts=1))
+    params = model.init(jax.random.PRNGKey(1))
+    path = str(tmp_path / "escn.npz")
+    save_params(path, params)
+    restored = load_params(path, like=params)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))

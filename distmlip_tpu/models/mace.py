@@ -11,10 +11,17 @@ Feature layout: equivariant node features are a dict {l: (N, C, 2l+1)}.
 Message construction (density projection):
     A_i^{l3} = (1/avg_n) sum_j sum_{l1,l2} R^{l1l2l3}(r_ij) *
                CG[(l1,l2,l3)] (h_j^{l1}, Y^{l2}(r_ij))
-followed by a species-weighted symmetric contraction (correlation <= 3,
-iterated pairwise couplings — spans the ACE product basis) and linear
-updates with residual connections. Per-layer invariant readouts accumulate
-into the site energy, matching MACE's scale/shift + E0s structure.
+followed by a species-weighted symmetric contraction in MACE's exact
+U-matrix parameterization (orthonormal symmetric coupling basis per
+(l_out, correlation) — ops/so3.py:symmetric_coupling_basis) and linear
+updates with species-dependent residual connections (upstream's skip_tp).
+Per-layer invariant readouts accumulate into the site energy, matching
+MACE's scale/shift + E0s structure.
+
+TPU mapping: the density projection folds every (l_h, l_Y, l_out) CG path
+into one dense block matrix so each edge chunk is a single MXU GEMM
+(_projection_tables); the symmetric contraction runs Horner-style over
+node chunks; segment sums ride the sorted-dst fast path.
 
 Distributed contract: one halo exchange of the packed node features after
 each interaction (same cadence as the reference's atom_transfer,
@@ -31,9 +38,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import radial
-from ..ops.nn import linear, linear_init, linear_init_vp, mlp, mlp_init
+from ..ops.nn import linear, linear_init, linear_init_vp, mlp, mlp_init, mlp_init_vp
 from ..ops.segment import masked_segment_sum
-from ..ops.so3 import real_clebsch_gordan, spherical_harmonics
+from ..ops.so3 import (
+    real_clebsch_gordan,
+    spherical_harmonics,
+    symmetric_coupling_basis,
+)
 
 
 @dataclass(frozen=True)
@@ -47,16 +58,28 @@ class MACEConfig:
     num_interactions: int = 2
     num_bessel: int = 8
     radial_mlp: int = 64
-    radial_scale: float = 4.0  # output gain on the radial MLP: keeps the
-                               # density projection A at O(1) so correlation-2/3
-                               # products carry weight at init
+    radial_layers: int = 3    # hidden layers in the radial MLP (upstream MACE
+                              # uses [64, 64, 64], no biases)
+    radial_scale: float = 16.0  # output gain on the radial MLP: keeps the
+                                # density projection A healthy at init (the
+                                # cutoff envelope shrinks near-cutoff edges)
+                                # so correlation-2/3 products carry weight
     cutoff: float = 5.0
     avg_num_neighbors: float = 14.0
+    num_heads: int = 1        # multi-head readouts (upstream MACE heads:
+    head: int = 0             # per-head E0s/scale/shift/readout columns);
+                              # ``head`` selects the column evaluated
+    zbl: bool = False         # ZBL screened pair repulsion under the
+                              # learned potential (ref mace/models.py:121-128)
+    atomic_numbers: tuple | None = None  # species index -> Z (for ZBL);
+                                         # default: index + 1
     remat: bool = True   # rematerialize each interaction in the backward pass
     edge_chunk: int = 32768  # process edges in chunks of this size inside a
                              # lax.scan: bounds the per-edge path-tensor and
                              # radial-weight memory regardless of system size
                              # (0 disables chunking)
+    node_chunk: int = 4096   # same for the per-node symmetric contraction
+                             # (the Horner intermediates are (n, C, d, S, S))
     dtype: str = "float32"
 
 
@@ -65,46 +88,74 @@ def _triangle(l1, l2, l3):
 
 
 def _message_paths(h_ls, l_max, out_ls):
-    """(l_h, l_Y, l_out) combos for the density projection."""
+    """(l_h, l_Y, l_out) combos for the density projection.
+
+    Parity-filtered (l_h + l_Y + l_out even): node features and spherical
+    harmonics carry SH parity, and upstream MACE's conv_tp keeps only the
+    parity-consistent instructions, so odd-sum paths do not exist there —
+    matching the path set (and radial-MLP output width) exactly is required
+    for weight parity."""
     return [
         (lh, ly, lo)
         for lh in h_ls
         for ly in range(l_max + 1)
         for lo in out_ls
-        if _triangle(lh, ly, lo)
+        if _triangle(lh, ly, lo) and (lh + ly + lo) % 2 == 0
     ]
 
 
-def _pair_paths(a_ls):
-    """(la, lb, li) pairwise couplings, la <= lb, dropping identically-zero
-    antisymmetric couplings of identical inputs."""
-    out = []
-    for la in a_ls:
-        for lb in a_ls:
-            if lb < la:
-                continue
-            for li in range(abs(la - lb), min(la + lb, max(a_ls)) + 1):
-                if la == lb and (la + lb + li) % 2 == 1:
-                    continue
-                out.append((la, lb, li))
-    return out
+def _projection_tables(h_ls, l_max, paths):
+    """MXU-shaped density projection: fold ALL (l_h, l_Y, l_out) CG couplings
+    into one dense block matrix so the per-edge work is a single GEMM.
 
+        W[(l_h m) * S_Y + (l_Y n), q(path, p)] = CG^{l_h l_Y l_out}[m, n, p]
 
-def _triple_paths(pairs, a_ls, out_ls):
-    """(pair_index, lc, lout) couplings for correlation 3."""
-    return [
-        (pi, lc, lo)
-        for pi, (la, lb, li) in enumerate(pairs)
-        for lc in a_ls
-        for lo in out_ls
-        if _triangle(li, lc, lo)
-    ]
+    Per edge: outer(h_src, Y) (E, C, S_h*S_Y) @ W (S_h*S_Y, Q) — one matmul
+    covering every path, instead of the per-path ``ecm,en,mnp->ecp`` einsums
+    that lowered to gather/VPU work (round-1 bottleneck, ROADMAP lever 1).
+
+    Returns dict with: W (K, Q) float64, q_path (Q,) path index per column,
+    h_off {l: row-block offset}, S_h, S_Y, and lo_cols {l_out: (P_l, 2l+1)}
+    column groups for the per-path output mixing.
+    """
+    S_Y = (l_max + 1) ** 2
+    h_off = {}
+    off = 0
+    for l in h_ls:
+        h_off[l] = off
+        off += 2 * l + 1
+    S_h = off
+    y_off = {l: l * l for l in range(l_max + 1)}
+
+    Q = sum(2 * lo + 1 for (_, _, lo) in paths)
+    W = np.zeros((S_h * S_Y, Q))
+    q_path = np.zeros(Q, dtype=np.int32)
+    cols_by_lo: dict[int, list] = {}
+    q = 0
+    for pi, (lh, ly, lo) in enumerate(paths):
+        cg = real_clebsch_gordan(lh, ly, lo)  # (2lh+1, 2ly+1, 2lo+1)
+        mi = h_off[lh] + np.arange(2 * lh + 1)
+        ni = y_off[ly] + np.arange(2 * ly + 1)
+        rows = (mi[:, None] * S_Y + ni[None, :]).reshape(-1)
+        W[np.ix_(rows, np.arange(q, q + 2 * lo + 1))] = cg.reshape(-1, 2 * lo + 1)
+        q_path[q : q + 2 * lo + 1] = pi
+        cols_by_lo.setdefault(lo, []).append(np.arange(q, q + 2 * lo + 1))
+        q += 2 * lo + 1
+    lo_cols = {lo: np.stack(cols) for lo, cols in cols_by_lo.items()}
+    return {
+        "W": W, "q_path": q_path, "h_off": h_off, "S_h": S_h, "S_Y": S_Y,
+        "lo_cols": lo_cols,
+    }
 
 
 class MACE:
     def __init__(self, config: MACEConfig = MACEConfig()):
         self.cfg = config
         c = config
+        if not 0 <= c.head < c.num_heads:
+            raise ValueError(
+                f"head={c.head} out of range for num_heads={c.num_heads}"
+            )
         self.h_ls0 = [0]
         self.h_ls = list(range(c.hidden_lmax + 1))
         self.a_ls = list(range(c.a_lmax + 1))
@@ -112,16 +163,22 @@ class MACE:
         for t in range(c.num_interactions):
             h_ls = self.h_ls0 if t == 0 else self.h_ls
             self.msg_paths.append(_message_paths(h_ls, c.l_max, self.a_ls))
-        self.pairs = _pair_paths(self.a_ls)
-        self.pairs_out = [p for p in self.pairs if p[2] <= c.hidden_lmax]
-        self.triples = (
-            _triple_paths(self.pairs, self.a_ls, self.h_ls)
-            if c.correlation >= 3
-            else []
-        )
-
-    def _cg(self, l1, l2, l3, dtype):
-        return jnp.asarray(real_clebsch_gordan(l1, l2, l3), dtype=dtype)
+        self.proj = [
+            _projection_tables(
+                self.h_ls0 if t == 0 else self.h_ls, c.l_max, self.msg_paths[t]
+            )
+            for t in range(c.num_interactions)
+        ]
+        # ACE product basis: orthonormal symmetric U tensors per
+        # (l_out, correlation), shared across interactions (the A irreps are
+        # the same every layer) — MACE's U-matrix symmetric contraction
+        self.prod_U = {
+            l: {
+                nu: symmetric_coupling_basis(tuple(self.a_ls), l, nu)
+                for nu in range(1, c.correlation + 1)
+            }
+            for l in self.h_ls
+        }
 
     # ---- parameters ----
     def init(self, key) -> dict:
@@ -131,11 +188,16 @@ class MACE:
         ks = iter(jax.random.split(key, n_keys))
         params = {
             "species_emb": {"w": jax.random.normal(next(ks), (cfg.num_species, C))},
-            "species_ref": {"w": jnp.zeros((cfg.num_species,))},
-            "scale": jnp.ones(()),
-            "shift": jnp.zeros(()),
+            "species_ref": {"w": jnp.zeros((cfg.num_heads, cfg.num_species))},
+            "scale": jnp.ones((cfg.num_heads,)),
+            "shift": jnp.zeros((cfg.num_heads,)),
             "interactions": [],
         }
+        if cfg.zbl:
+            params["zbl"] = {
+                "a_exp": jnp.float32(0.300),
+                "a_prefactor": jnp.float32(0.4543),
+            }
         for t in range(cfg.num_interactions):
             n_paths = len(self.msg_paths[t])
             inter = {
@@ -144,34 +206,53 @@ class MACE:
                     str(l): linear_init_vp(next(ks), C, C)
                     for l in (self.h_ls0 if t == 0 else self.h_ls)
                 },
-                "radial": mlp_init(
-                    next(ks), [cfg.num_bessel, cfg.radial_mlp, n_paths * C]
+                "radial": mlp_init_vp(
+                    next(ks),
+                    [cfg.num_bessel]
+                    + [cfg.radial_mlp] * cfg.radial_layers
+                    + [n_paths * C],
                 ),
+                # per-path output mixing (upstream MACE's post-conv_tp
+                # e3nn Linear: one C x C block per (path, l_out) pair)
                 "lin_A": {
-                    str(l): linear_init_vp(next(ks), C, C) for l in self.a_ls
+                    str(l): jax.random.normal(
+                        next(ks), (self.proj[t]["lo_cols"][l].shape[0], C, C)
+                    )
+                    / np.sqrt(self.proj[t]["lo_cols"][l].shape[0] * C)
+                    for l in self.a_ls
                 },
-                # species-dependent product-basis weights
-                "w1": jax.random.normal(next(ks), (cfg.num_species, len(self.h_ls), C))
-                * 0.5,
-                "w2": jax.random.normal(
-                    next(ks), (cfg.num_species, max(len(self.pairs_out), 1), C)
-                )
-                * 0.5,
-                "w3": jax.random.normal(
-                    next(ks), (cfg.num_species, max(len(self.triples), 1), C)
-                )
-                * 0.5,
+                # species-dependent U-basis product weights (MACE's
+                # symmetric-contraction weights: (num_elements, n_paths, C)
+                # per output irrep and correlation order)
+                "product": {
+                    str(l): {
+                        f"w{nu}": jax.random.normal(
+                            next(ks),
+                            (cfg.num_species, U.shape[-1], C),
+                        )
+                        / np.sqrt(U.shape[-1])
+                        for nu, U in self.prod_U[l].items()
+                        if U is not None
+                    }
+                    for l in self.h_ls
+                },
                 "lin_msg": {
                     str(l): linear_init_vp(next(ks), C, C) for l in self.h_ls
                 },
+                # species-dependent residual (upstream's skip_tp:
+                # FullyConnectedTensorProduct(h, species one-hot) — one C x C
+                # block per species per l)
                 "lin_res": {
-                    str(l): linear_init_vp(next(ks), C, C)
+                    str(l): jax.random.normal(
+                        next(ks), (cfg.num_species, C, C)
+                    )
+                    / np.sqrt(C)
                     for l in (self.h_ls0 if t == 0 else self.h_ls)
                 },
                 "readout": (
-                    mlp_init(next(ks), [C, 16, 1])
+                    mlp_init(next(ks), [C, 16, cfg.num_heads])
                     if t == cfg.num_interactions - 1
-                    else [linear_init(next(ks), C, 1)]
+                    else [linear_init(next(ks), C, cfg.num_heads)]
                 ),
             }
             params["interactions"].append(inter)
@@ -209,7 +290,10 @@ class MACE:
         h = {0: params["species_emb"]["w"][z][:, :, None]}  # (N, C, 1)
         h = self._unpack(lg.halo_exchange(self._pack(h)), [0], C)
 
-        e_site = params["species_ref"]["w"][z].astype(dtype)
+        head = cfg.head
+        e_site = params["species_ref"]["w"][head][z].astype(dtype)
+        if cfg.zbl:
+            e_site = e_site + self._zbl_site(params, lg, d, dtype)
         acc = jnp.zeros(positions.shape[0], dtype=dtype)
 
         for t, inter in enumerate(params["interactions"]):
@@ -220,14 +304,38 @@ class MACE:
             h = body(inter, h)
             h = self._unpack(lg.halo_exchange(self._pack(h)), self.h_ls, C)
 
-            # invariant readout
+            # invariant readout (head column selected)
             scalars = h[0][:, :, 0]
             if t == cfg.num_interactions - 1:
-                acc = acc + mlp(inter["readout"], scalars)[:, 0]
+                acc = acc + mlp(inter["readout"], scalars)[:, head]
             else:
-                acc = acc + linear(inter["readout"][0], scalars)[:, 0]
+                acc = acc + linear(inter["readout"][0], scalars)[:, head]
 
-        return e_site + params["scale"] * acc + params["shift"]
+        scale = params["scale"][head].astype(dtype)
+        shift = params["shift"][head].astype(dtype)
+        return e_site + scale * acc + shift
+
+    def _zbl_site(self, params, lg, d, dtype):
+        """Per-atom ZBL pair repulsion (half per directed edge), added under
+        the learned potential exactly as the reference aggregates its
+        per-partition pair energies (mace/models.py:121-128)."""
+        from .pair import zbl_edge_energy
+
+        cfg = self.cfg
+        if cfg.atomic_numbers is not None:
+            z_of = jnp.asarray(np.asarray(cfg.atomic_numbers, dtype=np.int32))
+        else:
+            z_of = jnp.arange(1, cfg.num_species + 1, dtype=jnp.int32)
+        z_num = z_of[lg.species]
+        e_edge = zbl_edge_energy(
+            z_num[lg.edge_src], z_num[lg.edge_dst], d.astype(dtype),
+            a_exp=params["zbl"]["a_exp"], a_prefactor=params["zbl"]["a_prefactor"],
+        )
+        e_edge = jnp.where(lg.edge_mask, e_edge, 0.0)
+        return 0.5 * masked_segment_sum(
+            e_edge[:, None], lg.edge_dst, lg.species.shape[0],
+            indices_are_sorted=True,
+        )[:, 0]
 
     def _interaction(self, inter, h, *, lg, Y, bessel, env, z, t):
         """One MACE interaction: density projection + symmetric contraction +
@@ -239,14 +347,26 @@ class MACE:
         n_nodes = h[0].shape[0]
         h_ls = self.h_ls0 if t == 0 else self.h_ls
         paths = self.msg_paths[t]
+        proj = self.proj[t]
+        Wp = jnp.asarray(proj["W"], dtype=dtype)          # (S_h*S_Y, Q)
+        q_path = jnp.asarray(proj["q_path"])              # (Q,)
+        nQ = proj["W"].shape[1]
 
-        # sender features, channel-mixed per l
-        hu = {
-            l: jnp.einsum("ncm,cd->ndm", h[l], inter["lin_up"][str(l)]["w"])
-            for l in h_ls
-        }
+        # sender features, channel-mixed per l, packed (N, C, S_h)
+        hu = jnp.concatenate(
+            [
+                jnp.einsum("ncm,cd->ndm", h[l], inter["lin_up"][str(l)]["w"])
+                for l in h_ls
+            ],
+            axis=-1,
+        )
+        Y_full = jnp.concatenate(
+            [Y[l] for l in range(cfg.l_max + 1)], axis=-1
+        ).astype(dtype)                                   # (E, S_Y)
 
-        # density projection A, accumulated over edge chunks (memory-bounded)
+        # density projection A, accumulated over edge chunks (memory-bounded):
+        # per chunk, outer(h_src, Y) -> one GEMM over every CG path -> radial
+        # weight -> ONE sorted segment sum carrying all Q path components
         e_cap = lg.edge_src.shape[0]
         chunk = cfg.edge_chunk if cfg.edge_chunk > 0 else e_cap
         chunk = min(chunk, e_cap)
@@ -271,71 +391,125 @@ class MACE:
         mask_ch = pad_c(lg.edge_mask).reshape(K, chunk)
         env_ch = pad_c(env).reshape(K, chunk)
         bes_ch = pad_c(bessel).reshape(K, chunk, -1)
-        Y_ch = {l: pad_c(Y[l]).reshape(K, chunk, -1) for l in Y}
+        Y_ch = pad_c(Y_full).reshape(K, chunk, -1)
 
         def chunk_body(A_acc, xs):
-            srcc, dstc, maskc, envc, besc, Yc = xs
+            srcc, dstc, maskc, envc, Yc, besc = xs
             Rc = mlp(inter["radial"], besc).reshape(chunk, len(paths), C) * (
                 cfg.radial_scale * envc
             )[:, None, None]
-            for pi, (lh, ly, lo) in enumerate(paths):
-                cgt = self._cg(lh, ly, lo, dtype)
-                m = jnp.einsum(
-                    "ecm,en,mnp->ecp", hu[lh][srcc], Yc[ly], cgt
-                ) * Rc[:, pi, :, None]
-                A_acc[lo] = A_acc[lo] + masked_segment_sum(
-                    m, dstc, A_acc[lo].shape[0], maskc, indices_are_sorted=True
-                )
-            return A_acc, None
+            outer = hu[srcc][:, :, :, None] * Yc[:, None, None, :]
+            M = outer.reshape(chunk, C, -1) @ Wp          # (E_c, C, Q) [MXU]
+            M = M * jnp.swapaxes(Rc[:, q_path, :], 1, 2)  # per-path radial
+            return (
+                A_acc
+                + masked_segment_sum(
+                    M, dstc, n_nodes, maskc, indices_are_sorted=True
+                ),
+                None,
+            )
 
-        A0 = {
-            l: jnp.zeros((n_nodes, C, 2 * l + 1), dtype=dtype)
-            for l in self.a_ls
-        }
+        A0 = jnp.zeros((n_nodes, C, nQ), dtype=dtype)
         if K == 1:
-            A, _ = chunk_body(A0, (src_ch[0], dst_ch[0], mask_ch[0], env_ch[0],
-                                   bes_ch[0], {l: Y_ch[l][0] for l in Y_ch}))
+            A_all, _ = chunk_body(
+                A0, (src_ch[0], dst_ch[0], mask_ch[0], env_ch[0], Y_ch[0],
+                     bes_ch[0])
+            )
         else:
             body = jax.checkpoint(chunk_body) if cfg.remat else chunk_body
-            A, _ = jax.lax.scan(
-                body, A0,
-                (src_ch, dst_ch, mask_ch, env_ch, bes_ch, Y_ch),
+            A_all, _ = jax.lax.scan(
+                body, A0, (src_ch, dst_ch, mask_ch, env_ch, Y_ch, bes_ch)
             )
+        # per-path output mixing on nodes (upstream's post-conv_tp linear):
+        # A[l] = sum_paths A_all[:, :, cols(path)] @ W_path — (P_l*C) GEMMs
         inv_avg = jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)
         A = {
-            l: jnp.einsum("ncm,cd->ndm", A[l] * inv_avg, inter["lin_A"][str(l)]["w"])
+            l: jnp.einsum(
+                "ncpm,pcd->ndm",
+                A_all[:, :, proj["lo_cols"][l]] * inv_avg,
+                inter["lin_A"][str(l)].astype(dtype),
+            )
             for l in self.a_ls
         }
 
-        # symmetric contraction (correlation <= 3), species-weighted
-        w1 = inter["w1"][z]  # (N, |h_ls|, C)
-        w2 = inter["w2"][z]
-        w3 = inter["w3"][z]
-        B = {l: w1[:, i, :, None] * A[l] for i, l in enumerate(self.h_ls)}
-        if cfg.correlation >= 2:
-            P = []
-            out_i = 0
-            for la, lb, li in self.pairs:
-                cgt = self._cg(la, lb, li, dtype)
-                p = jnp.einsum("ncm,ncq,mqp->ncp", A[la], A[lb], cgt)
-                P.append((li, p))
-                if li <= cfg.hidden_lmax:
-                    B[li] = B[li] + w2[:, out_i, :, None] * p
-                    out_i += 1
-            if cfg.correlation >= 3:
-                for ti, (pi, lc, lo) in enumerate(self.triples):
-                    li, p = P[pi]
-                    cgt = self._cg(li, lc, lo, dtype)
-                    q = jnp.einsum("ncm,ncq,mqp->ncp", p, A[lc], cgt)
-                    B[lo] = B[lo] + w3[:, ti, :, None] * q
+        # ---- symmetric contraction (ACE product basis, U-matrix form) ----
+        # node-chunked: the Horner intermediates are (n, C, d, S, S)
+        A_flat = jnp.concatenate([A[l] for l in self.a_ls], axis=-1)  # (N,C,S_A)
+        h_in_ls = [l for l in h_ls if l in h]
+        h_flat = jnp.concatenate([h[l] for l in h_in_ls], axis=-1)
+        nchunk = cfg.node_chunk if cfg.node_chunk > 0 else n_nodes
+        nchunk = min(nchunk, n_nodes)
+        Kn = -(-n_nodes // nchunk)
+        padn = Kn * nchunk - n_nodes
 
-        # message linear + residual update
-        h_new = {}
-        for l in self.h_ls:
-            m = jnp.einsum("ncm,cd->ndm", B[l], inter["lin_msg"][str(l)]["w"])
-            if l in h and str(l) in inter["lin_res"]:
-                m = m + jnp.einsum(
-                    "ncm,cd->ndm", h[l], inter["lin_res"][str(l)]["w"]
+        def padn_c(x):
+            if padn == 0:
+                return x
+            widths = [(0, padn)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths)
+
+        A_ch = padn_c(A_flat).reshape(Kn, nchunk, C, -1)
+        z_ch = padn_c(z).reshape(Kn, nchunk)
+        h_ch = padn_c(h_flat).reshape(Kn, nchunk, C, -1)
+
+        def node_body(_, xs):
+            Ac, zc, hc = xs
+            outs = []
+            for l in self.h_ls:
+                B = self._sym_contract(
+                    inter["product"][str(l)], self.prod_U[l], Ac, zc, dtype
                 )
-            h_new[l] = m
+                m = jnp.einsum("ncm,cd->ndm", B, inter["lin_msg"][str(l)]["w"])
+                if l in h_in_ls and str(l) in inter["lin_res"]:
+                    off = sum(2 * ll + 1 for ll in h_in_ls if ll < l)
+                    hl = hc[:, :, off : off + 2 * l + 1]
+                    Wr = inter["lin_res"][str(l)][zc].astype(dtype)  # (n,C,C)
+                    m = m + jnp.einsum("ncm,ncd->ndm", hl, Wr)
+                outs.append(m)
+            return None, jnp.concatenate(outs, axis=-1)
+
+        if Kn == 1:
+            _, out_flat = node_body(None, (A_ch[0], z_ch[0], h_ch[0]))
+        else:
+            body = jax.checkpoint(node_body) if cfg.remat else node_body
+            _, out_flat = jax.lax.scan(body, None, (A_ch, z_ch, h_ch))
+            out_flat = out_flat.reshape(Kn * nchunk, C, -1)[:n_nodes]
+
+        h_new = {}
+        o = 0
+        for l in self.h_ls:
+            d = 2 * l + 1
+            h_new[l] = out_flat[..., o : o + d]
+            o += d
         return h_new
+
+    def _sym_contract(self, wts, Us, Ac, zc, dtype):
+        """B(A)[n, c, d] = sum_nu W_nu[z_n] . U_nu . A^(x nu) — evaluated
+        highest correlation first in Horner form (mace's contraction order:
+        each step adds the next-lower U.W block, then contracts one A index).
+        Ac: (n, C, S_A); returns (n, C, 2l+1)."""
+        numax = max(nu for nu, U in Us.items() if U is not None)
+        letters = "uvwxy"
+        # U stored (S,)*nu + (d, k) -> transpose to (d, S..., k)
+        U_t = {
+            nu: jnp.asarray(np.moveaxis(U, -2, 0), dtype=dtype)
+            for nu, U in Us.items()
+            if U is not None
+        }
+        w = {nu: wts[f"w{nu}"][zc].astype(dtype) for nu in U_t}  # (n, k, C)
+
+        s_in = letters[: numax - 1]
+        # G[n,k,q,c] = w[n,k,c] A[n,c,q]: fold the path and last tensor index
+        # into one MXU contraction of U against G
+        G = jnp.einsum("nkc,ncq->nkqc", w[numax], Ac)
+        t = jnp.einsum(f"d{s_in}qk,nkqc->ncd{s_in}", U_t[numax], G)
+        for nu in range(numax - 1, 0, -1):
+            s_cur = letters[:nu]
+            if nu in U_t:
+                t = t + jnp.einsum(
+                    f"d{s_cur}k,nkc->ncd{s_cur}", U_t[nu], w[nu]
+                )
+            t = jnp.einsum(
+                f"ncd{s_cur},nc{s_cur[-1]}->ncd{s_cur[:-1]}", t, Ac
+            )
+        return t

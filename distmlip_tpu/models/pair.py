@@ -1,8 +1,10 @@
-"""Simple analytic pair potentials (Lennard-Jones, Morse).
+"""Simple analytic pair potentials (Lennard-Jones, Morse, ZBL).
 
 Useful as fast baselines, MD integrator test oracles, and runtime
 smoke-tests — and as the minimal example of the model contract:
-``energy_fn(params, lg, positions) -> per-atom energies``.
+``energy_fn(params, lg, positions) -> per-atom energies``. The ZBL
+universal screened-Coulomb repulsion here is the pair baseline MACE adds
+under its learned potential (reference mace/models.py:121-128).
 """
 
 from __future__ import annotations
@@ -10,9 +12,52 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops import radial
 from ..ops.segment import masked_segment_sum
+
+# Covalent radii in Å (Cordero et al. 2008), indexed by atomic number Z;
+# index 0 unused. Used for the per-pair ZBL cutoff r_max = r_cov(Zu)+r_cov(Zv).
+COVALENT_RADII = np.array([
+    0.00,
+    0.31, 0.28, 1.28, 0.96, 0.84, 0.76, 0.71, 0.66, 0.57, 0.58,
+    1.66, 1.41, 1.21, 1.11, 1.07, 1.05, 1.02, 1.06, 2.03, 1.76,
+    1.70, 1.60, 1.53, 1.39, 1.39, 1.32, 1.26, 1.24, 1.32, 1.22,
+    1.22, 1.20, 1.19, 1.20, 1.20, 1.16, 2.20, 1.95, 1.90, 1.75,
+    1.64, 1.54, 1.47, 1.46, 1.42, 1.39, 1.45, 1.44, 1.42, 1.39,
+    1.39, 1.38, 1.39, 1.40, 2.44, 2.15, 2.07, 2.04, 2.03, 2.01,
+    1.99, 1.98, 1.98, 1.96, 1.94, 1.92, 1.92, 1.89, 1.90, 1.87,
+    1.87, 1.75, 1.70, 1.62, 1.51, 1.44, 1.41, 1.36, 1.36, 1.32,
+    1.45, 1.46, 1.48, 1.40, 1.50, 1.50, 2.60, 2.21, 2.15, 2.06,
+    2.00, 1.96, 1.90, 1.87, 1.80, 1.69,
+])
+
+# ZBL universal screening function coefficients
+_ZBL_C = (0.18175, 0.50986, 0.28022, 0.02817)
+_ZBL_D = (3.19980, 0.94229, 0.40290, 0.20162)
+_COULOMB_EV_ANG = 14.399645  # e^2 / (4 pi eps0) in eV*Å
+
+
+def zbl_edge_energy(z_u, z_v, d, a_exp=0.300, a_prefactor=0.4543, p: int = 6):
+    """ZBL screened nuclear repulsion per directed edge, in eV.
+
+    V(r) = (14.3996 eV*Å) Zu Zv / r * phi(r / a),
+    a = a_prefactor * a0 / (Zu^a_exp + Zv^a_exp),
+    smoothly cut at r_max = r_cov(Zu) + r_cov(Zv) by the polynomial
+    envelope. a_exp/a_prefactor are trainable in upstream MACE; defaults
+    match its init.
+    """
+    z_u = z_u.astype(d.dtype)
+    z_v = z_v.astype(d.dtype)
+    a = a_prefactor * 0.529177 / (z_u**a_exp + z_v**a_exp)
+    x = d / a
+    phi = sum(c * jnp.exp(-dd * x) for c, dd in zip(_ZBL_C, _ZBL_D))
+    v = _COULOMB_EV_ANG * z_u * z_v / jnp.maximum(d, 1e-6) * phi
+    cov = jnp.asarray(COVALENT_RADII, dtype=d.dtype)
+    r_max = cov[z_u.astype(jnp.int32)] + cov[z_v.astype(jnp.int32)]
+    env = radial.polynomial_cutoff(d, r_max, p=p) * (d < r_max)
+    return v * env
 
 
 @dataclass(frozen=True)

@@ -45,6 +45,19 @@ def linear_init_vp(key, d_in: int, d_out: int):
     return {"w": jax.random.normal(key, (d_in, d_out)) / np.sqrt(d_in)}
 
 
+def mlp_init_vp(key, dims: list[int], act_gain: float = 1.679):
+    """Bias-free variance-preserving MLP init (e3nn FullyConnectedNet
+    convention): W ~ N(0, g^2/d_in), with g compensating silu's second
+    moment (E[silu(x)^2] ~ 0.355 under N(0,1) -> gain ~ 1.679) on layers
+    fed by an activation, so deep bias-free stacks keep O(1) outputs."""
+    keys = jax.random.split(key, len(dims) - 1)
+    out = []
+    for i, (k, a, b) in enumerate(zip(keys, dims[:-1], dims[1:])):
+        g = act_gain if i > 0 else 1.0
+        out.append({"w": jax.random.normal(k, (a, b)) * (g / np.sqrt(a))})
+    return out
+
+
 def mlp_init(key, dims: list[int], bias: bool = True):
     keys = jax.random.split(key, len(dims) - 1)
     return [linear_init(k, a, b, bias=bias) for k, a, b in zip(keys, dims[:-1], dims[1:])]

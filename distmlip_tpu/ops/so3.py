@@ -204,6 +204,97 @@ def real_clebsch_gordan(l1: int, l2: int, l3: int) -> np.ndarray | None:
     return np.ascontiguousarray(C)
 
 
+@lru_cache(maxsize=None)
+def symmetric_coupling_basis(a_ls: tuple, l_out: int, nu: int):
+    """Orthonormal basis of O(3)-equivariant, totally symmetric maps
+    Sym^nu(V_A) -> V_{l_out}, with V_A = ⊕_{l in a_ls} R^{2l+1} (SH parity).
+
+    This is the function space MACE's U-matrix symmetric contraction spans
+    (reference wraps it via e3nn in ScaleShiftMACE, mace/models.py:45-220):
+    the ACE product basis at correlation ``nu``. Returns U of shape
+    (S_A,)*nu + (2*l_out+1, n_paths) with orthonormal path columns (full
+    tensor-space inner product), or None when the space is empty. Any two
+    complete orthonormal bases of this space differ only by an orthogonal
+    path mixing, so upstream U-basis weights can be converted exactly with
+    a change-of-basis solve against the upstream U tensors.
+
+    Construction: parametrize symmetric tensors by monomial multi-indices,
+    build the rotation action in that basis, and take the joint null space
+    of (D_sym ⊗ D_out - I) over random rotations PLUS the inversion -I
+    (imposing e3nn's parity selection: paths with odd total l vanish).
+    """
+    a_ls = tuple(a_ls)
+    S_A = sum(2 * l + 1 for l in a_ls)
+    d_out = 2 * l_out + 1
+    if S_A**nu > 50_000:
+        # the construction materializes dense (S_A^nu)^2 Kronecker rotation
+        # matrices; beyond this the host-side cost explodes
+        raise ValueError(
+            f"symmetric_coupling_basis: S_A^nu = {S_A}^{nu} too large; "
+            f"reduce a_lmax or correlation"
+        )
+    lvals = np.concatenate([[l] * (2 * l + 1) for l in a_ls]).astype(int)
+
+    from itertools import combinations_with_replacement, permutations
+
+    idxs = list(combinations_with_replacement(range(S_A), nu))
+    dim_sym = len(idxs)
+    full = S_A**nu
+
+    # embedding S: sym basis -> full tensor space (orthonormal columns)
+    S = np.zeros((full, dim_sym))
+    strides = np.array([S_A ** (nu - 1 - i) for i in range(nu)])
+    for a, alpha in enumerate(idxs):
+        perms = set(permutations(alpha))
+        w = 1.0 / np.sqrt(len(perms))
+        for p in perms:
+            S[int(np.dot(p, strides)), a] = w
+
+    def d_full(R):
+        D_blocks = [wigner_d_from_rotation(l, R) for l in a_ls]
+        D = np.zeros((S_A, S_A))
+        o = 0
+        for l, Db in zip(a_ls, D_blocks):
+            D[o : o + 2 * l + 1, o : o + 2 * l + 1] = Db
+            o += 2 * l + 1
+        out = D
+        for _ in range(nu - 1):
+            out = np.kron(out, D)
+        return out
+
+    rng = np.random.default_rng(7041)
+    rows = []
+    dim_c = dim_sym * d_out
+    for k in range(3):
+        R = _random_rotation(rng)
+        D_sym = S.T @ d_full(R) @ S
+        D_out = wigner_d_from_rotation(l_out, R)
+        rows.append(np.kron(D_sym, D_out) - np.eye(dim_c))
+    # inversion: D_l(-I) = (-1)^l per block -> parity selection
+    par_sym = S.T @ np.diag(
+        np.asarray(
+            [(-1.0) ** lvals.take(np.unravel_index(i, (S_A,) * nu)).sum()
+             for i in range(full)]
+        )
+    ) @ S
+    rows.append(np.kron(par_sym, np.eye(d_out) * (-1.0) ** l_out) - np.eye(dim_c))
+    A = np.vstack(rows)
+    _, s, Vt = np.linalg.svd(A, full_matrices=True)
+    n_paths = int(np.sum(s < 1e-8))
+    if n_paths == 0:
+        return None
+    null = Vt[-n_paths:]  # rows of Vt for (near-)zero singular values
+    # guard the spectral gap so the path count is unambiguous
+    if n_paths < dim_c and s[dim_c - n_paths - 1] < 1e-5:
+        raise RuntimeError(
+            f"symmetric basis ({a_ls}, l_out={l_out}, nu={nu}): borderline "
+            f"singular value {s[dim_c - n_paths - 1]:.2e}"
+        )
+    U = (S @ null.reshape(n_paths, dim_sym, d_out).transpose(1, 2, 0).reshape(
+        dim_sym, -1)).reshape((S_A,) * nu + (d_out, n_paths))
+    return np.ascontiguousarray(U)
+
+
 # ---------------------------------------------------------------------------
 # Batched Wigner matrices on device (for eSCN-style edge-frame rotations).
 # ---------------------------------------------------------------------------
@@ -233,23 +324,44 @@ def wigner_d_batch(l_max: int, R):
 def rotation_to_z(u):
     """Batch of rotation matrices R with R @ u = z_hat (..., 3) -> (..., 3, 3).
 
-    Smooth except at u = -z (handled by a stabilized formula). Used to align
-    edge vectors with the z axis for SO(2) convolutions.
+    Exact for every u including u = -z (where the single-chart Rodrigues
+    formula is singular — the reference's eSCN handles this case explicitly
+    in its edge-rotation init). Two charts selected per edge:
+
+      z >= 0:  R = I + [v]_x + [v]_x^2 / (1 + z),  v = u x z_hat
+      z <  0:  R = chartA(Rx(pi) @ u) @ Rx(pi),    Rx(pi) = diag(1,-1,-1)
+
+    Both denominators are >= 1 on their half-space, so the construction is
+    numerically exact (orthogonal to machine precision) everywhere. The two
+    charts differ by a gauge rotation about z at the seam; eSCN's SO(2)
+    convolutions are gauge-equivariant, so model outputs are unaffected.
+    Used to align edge vectors with the z axis for SO(2) convolutions.
     """
     import jax.numpy as jnp
 
     x, y, z = u[..., 0], u[..., 1], u[..., 2]
-    # Rodrigues closed form: R = I + [v]_x + [v]_x^2 / (1 + c) rotates u onto
-    # z, with v = u x z = (y, -x, 0) and c = u . z = z.
-    denom = jnp.maximum(1.0 + z, 1e-6)
-    vx, vy = y, -x
-    zero = jnp.zeros_like(x)
-    K = jnp.stack([
-        jnp.stack([zero, zero, vy], axis=-1),
-        jnp.stack([zero, zero, -vx], axis=-1),
-        jnp.stack([-vy, vx, zero], axis=-1),
-    ], axis=-2)
+    cond = z >= 0.0
     eye = jnp.eye(3, dtype=u.dtype)
-    K2 = jnp.einsum("...ij,...jk->...ik", K, K)
-    R = eye + K + K2 / denom[..., None, None]
-    return R
+
+    def chart(xc, yc, zc, denom):
+        # Rodrigues closed form: R = I + [v]_x + [v]_x^2 / (1 + c) rotates
+        # (xc, yc, zc) onto z, with v = u x z = (yc, -xc, 0) and c = zc.
+        vx, vy = yc, -xc
+        zero = jnp.zeros_like(xc)
+        K = jnp.stack([
+            jnp.stack([zero, zero, vy], axis=-1),
+            jnp.stack([zero, zero, -vx], axis=-1),
+            jnp.stack([-vy, vx, zero], axis=-1),
+        ], axis=-2)
+        K2 = jnp.einsum("...ij,...jk->...ik", K, K)
+        return eye + K + K2 / denom[..., None, None]
+
+    # clamp each chart's denominator on the half-space where it is unused so
+    # the inactive branch stays NaN-free under grad
+    one = jnp.ones_like(z)
+    R_a = chart(x, y, z, jnp.where(cond, 1.0 + z, one))
+    R_b = chart(x, -y, -z, jnp.where(cond, one, 1.0 - z))
+    # compose chart B with Rx(pi): R_b' @ (Rx(pi) @ u) = z  =>  (R_b' Rx(pi)) u = z
+    rx_pi = jnp.asarray(np.diag([1.0, -1.0, -1.0]), dtype=u.dtype)
+    R_b = jnp.einsum("...ij,jk->...ik", R_b, rx_pi)
+    return jnp.where(cond[..., None, None], R_a, R_b)

@@ -46,16 +46,21 @@ def test_rotation_invariance(rng, params):
 
 def test_higher_order_terms_contribute(rng, params):
     """Correlation-3 paths must change the energy (w3 zeroed vs not)."""
-    cart, lattice, species = make_crystal(rng, reps=(2, 2, 2))
-    e1, _, _ = run_potential(MODEL.energy_fn, params, cart, lattice, species,
-                             CFG.cutoff, 1, compute_stress=False)
-    p0 = jax.tree.map(lambda x: x, params)
-    p0 = jax.device_get(p0)
     import copy
 
-    p0 = copy.deepcopy(params)
+    cart, lattice, species = make_crystal(rng, reps=(2, 2, 2))
+    # amplify w3 in both runs: init magnitudes leave the cubic term near
+    # fp32 resolution (the cutoff envelope shrinks near-cutoff edges)
+    p1 = copy.deepcopy(params)
+    for inter in p1["interactions"]:
+        for l, wts in inter["product"].items():
+            wts["w3"] = wts["w3"] * 100.0
+    e1, _, _ = run_potential(MODEL.energy_fn, p1, cart, lattice, species,
+                             CFG.cutoff, 1, compute_stress=False)
+    p0 = copy.deepcopy(p1)
     for inter in p0["interactions"]:
-        inter["w3"] = inter["w3"] * 0.0
+        for l, wts in inter["product"].items():
+            wts["w3"] = wts["w3"] * 0.0
     e2, _, _ = run_potential(MODEL.energy_fn, p0, cart, lattice, species,
                              CFG.cutoff, 1, compute_stress=False)
     assert abs(e1 - e2) > 1e-4
@@ -106,3 +111,63 @@ def test_energy_smooth_at_cutoff(rng, params):
                                 CFG.cutoff, 1, compute_stress=False)
         es.append(e)
     assert np.ptp(es) < 2e-3
+
+
+def test_zbl_pair_repulsion(rng):
+    """ZBL: strongly repulsive at short range, smooth at its own cutoff,
+    and exactly zero beyond the covalent-radii sum."""
+    from distmlip_tpu.models.pair import COVALENT_RADII, zbl_edge_energy
+    import jax.numpy as jnp
+
+    cfg = MACEConfig(
+        num_species=4, channels=8, l_max=1, a_lmax=1, hidden_lmax=1,
+        correlation=2, num_interactions=1, num_bessel=4, radial_mlp=8,
+        cutoff=3.2, avg_num_neighbors=6.0, zbl=True,
+        atomic_numbers=(14, 14, 8, 8),
+    )
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    lattice = np.eye(3) * 20.0
+    species = np.zeros(2, np.int32)
+
+    def e_at(dd):
+        cart = np.array([[5.0, 5.0, 5.0], [5.0 + dd, 5.0, 5.0]])
+        e, _, _ = run_potential(model.energy_fn, params, cart, lattice,
+                                species, cfg.cutoff, 1, compute_stress=False)
+        return e
+
+    r_max = 2 * COVALENT_RADII[14]
+    assert e_at(0.6) - e_at(1.2) > 10.0          # strongly repulsive
+    # smooth (continuous) across the ZBL cutoff
+    es = [e_at(d) for d in np.linspace(r_max - 0.02, r_max + 0.02, 7)]
+    assert np.ptp(es) < 1e-3
+    # edge-level: exact zero beyond r_max
+    v = zbl_edge_energy(jnp.asarray([14]), jnp.asarray([14]),
+                        jnp.asarray([r_max + 0.01]))
+    assert float(v[0]) == 0.0
+
+
+def test_multihead_readout(rng):
+    """Heads must be independent: changing head-1 params leaves head 0
+    unchanged; selecting head 1 changes the energy."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, num_heads=2)
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    params["species_ref"]["w"] = params["species_ref"]["w"].at[1].set(3.0)
+    params["shift"] = params["shift"].at[1].set(-1.0)
+    cart, lattice, species = make_crystal(rng, reps=(2, 2, 2))
+    e0, _, _ = run_potential(model.energy_fn, params, cart, lattice, species,
+                             cfg.cutoff, 1, compute_stress=False)
+    m1 = MACE(dataclasses.replace(cfg, head=1))
+    e1, _, _ = run_potential(m1.energy_fn, params, cart, lattice, species,
+                             cfg.cutoff, 1, compute_stress=False)
+    assert abs(e0 - e1) > 1.0
+    # head-0 energy must not depend on head-1 columns
+    p2 = jax.device_get(params)
+    p2["species_ref"]["w"] = np.array(p2["species_ref"]["w"])
+    p2["species_ref"]["w"][1] = 99.0
+    e0b, _, _ = run_potential(model.energy_fn, p2, cart, lattice, species,
+                              cfg.cutoff, 1, compute_stress=False)
+    assert abs(e0 - e0b) < 1e-6

@@ -30,13 +30,16 @@ def _t(x):
 class Rule:
     """Maps one torch tensor onto one pytree leaf path.
 
-    path: tuple of keys/indices into the params pytree.
+    path: tuple of keys/indices into the params pytree. ``path=None`` marks a
+    consume-only rule: the tensor is accounted for (buffers like cutoff
+    constants, e3nn output masks, U matrices) and ``transform``, if given,
+    runs as a validation hook.
     transform: applied to the torch array (default: linear weights transpose,
     since torch nn.Linear stores (out, in) and this framework uses (in, out)).
     """
 
     torch_name: str
-    path: tuple
+    path: tuple | None
     transform: Callable[[np.ndarray], np.ndarray] | None = None
 
 
@@ -61,6 +64,11 @@ def convert(state_dict: dict, params, rules: list[Rule], strict: bool = True):
                 raise KeyError(f"torch checkpoint missing {r.torch_name!r}")
             continue
         arr = _t(state_dict[r.torch_name])
+        if r.path is None:
+            if r.transform is not None:
+                r.transform(arr)  # validation hook
+            used.add(r.torch_name)
+            continue
         if r.transform is not None:
             arr = r.transform(arr)
         set_in(params, r.path, arr)
@@ -101,8 +109,420 @@ def register_mapping(name: str):
     return deco
 
 
-def from_torch(arch: str, state_dict: dict, params, strict: bool = True):
+# ---------------------------------------------------------------------------
+# MACE (mace-torch ScaleShiftMACE) mapping
+# ---------------------------------------------------------------------------
+
+def _silu_2mom_gain() -> float:
+    """e3nn's normalize2mom(silu) constant — shared with ops/nn.py's
+    variance-preserving init (single source of truth). e3nn estimates the
+    same constant by sampling, so folded weights agree to ~1e-3 relative
+    (documented in PARITY.md)."""
+    from ..ops.nn import silu_2mom_gain
+
+    return silu_2mom_gain()
+
+
+def _scaled(alpha):
+    return lambda a: a * alpha
+
+
+def _find_u_buffer(sd: dict, prefix: str, S_A: int, nu: int):
+    """Locate the U-matrix buffer for correlation ``nu`` under a mace
+    symmetric-contraction prefix and canonicalize it to ((S_A^nu * d), k):
+    upstream stores (d?, S..., S, k) with the output axis leading; ours is
+    (S,)*nu + (d, k). Preference order: a key whose trailing digits name the
+    correlation (``U_matrix_{nu}``); fallback: axis-shape matching."""
+    import re
+
+    candidates = [
+        k for k in sd
+        if k.startswith(prefix)
+        and ("U_matrix" in k.rsplit(".", 1)[-1]
+             or "U_tensors" in k.rsplit(".", 1)[-1])
+    ]
+
+    def canonical(arr):
+        s_axes = [i for i, s in enumerate(arr.shape) if s == S_A][:nu]
+        if len(s_axes) < nu:
+            return None
+        d_axes = [i for i in range(arr.ndim - 1)
+                  if i not in s_axes and i != arr.ndim - 1]
+        if len(d_axes) > 1:
+            return None
+        order = s_axes + d_axes + [arr.ndim - 1]
+        can = np.transpose(arr, order)
+        return can.reshape(-1, can.shape[-1])
+
+    # exact name match first
+    for key in candidates:
+        m = re.search(r"(\d+)$", key)
+        if m and int(m.group(1)) == nu:
+            can = canonical(_t(sd[key]))
+            if can is not None:
+                return can
+    # shape-based fallback
+    for key in candidates:
+        arr = _t(sd[key])
+        if sum(1 for s in arr.shape if s == S_A) == nu:
+            can = canonical(arr)
+            if can is not None:
+                return can
+    return None
+
+
+def _basis_change(U_ours: np.ndarray, U_up_flat: np.ndarray) -> np.ndarray:
+    """T with U_up = U_ours @ T (both bases of the same coupling space).
+
+    U_ours has orthonormal columns, so T = U_ours^T U_up and the solve is
+    exact whenever upstream's basis spans the same space — verified by the
+    residual check (loud failure otherwise)."""
+    flat = U_ours.reshape(-1, U_ours.shape[-1])
+    T = flat.T @ U_up_flat
+    resid = np.linalg.norm(U_up_flat - flat @ T)
+    denom = max(np.linalg.norm(U_up_flat), 1e-12)
+    if resid / denom > 1e-5:
+        raise ValueError(
+            f"upstream U matrix is not in the span of the native symmetric "
+            f"basis (relative residual {resid / denom:.2e}); irreps/"
+            f"correlation mismatch?"
+        )
+    return T
+
+
+def _path_signs(sd: dict, inter: dict, a_ls: tuple, paths=None):
+    """Per-path ±1 from ``__cg_sign__`` calibration entries, in the message
+    path order (None when the export carries no calibration). ``paths`` is
+    authoritative when the caller passes the model; otherwise the set is
+    reconstructed from the weight shapes (must be unambiguous)."""
+    if not any(k.startswith("__cg_sign__") for k in sd):
+        return None
+    if paths is None:
+        from .mace import _message_paths
+
+        h_ls_in = sorted(int(l) for l in inter["lin_up"])
+        C = np.shape(inter["lin_up"][str(h_ls_in[0])]["w"])[0]
+        n_paths = np.shape(inter["radial"][-1]["w"])[1] // C
+        matching = {
+            tuple(p)
+            for lm in range(7)
+            if len(p := _message_paths(h_ls_in, lm, list(a_ls))) == n_paths
+        }
+        if len(matching) != 1:
+            raise ValueError(
+                "cannot reconstruct the message-path set from weight shapes; "
+                "pass the model to from_torch(..., model=model) so CG sign "
+                "calibration can be applied unambiguously"
+            )
+        paths = list(next(iter(matching)))
+    signs = np.ones(len(paths))
+    for i, (lh, ly, lo) in enumerate(paths):
+        key = f"__cg_sign__.{lh}.{ly}.{lo}"
+        if key not in sd:
+            # calibration IS present but misses this path: defaulting to +1
+            # would be the silent wrong-sign failure calibration exists to
+            # prevent
+            raise ValueError(
+                f"export carries __cg_sign__ calibration but no entry for "
+                f"message path (l_h={lh}, l_Y={ly}, l_out={lo}); re-export "
+                f"with tools/export_upstream.py covering l_max >= "
+                f"{max(lh, ly, lo)}"
+            )
+        signs[i] = float(np.ravel(_t(sd[key]))[0])
+    return signs
+
+
+@register_mapping("mace")
+def mace_mapping(params, sd, model=None):
+    """mace-torch ``ScaleShiftMACE.state_dict()`` -> MACE params.
+
+    Exact-name coverage of the MACE-MP-0 family layout (the reference wraps
+    these checkpoints via from_existing, mace/models.py:252-263):
+    e3nn flat Linear weights are split into per-irrep blocks with the
+    1/sqrt(fan_in) path normalization folded in; the radial FullyConnectedNet
+    folds e3nn's normalize2mom(silu) gain into post-activation layers; the
+    symmetric-contraction weights are basis-changed exactly against the
+    checkpoint's own U-matrix buffers (_basis_change). See PARITY.md for the
+    two documented approximations (sampled vs quadrature silu gain; CG sign
+    conventions calibrated via tools/export_upstream.py when needed).
+    """
+    from ..ops.so3 import symmetric_coupling_basis
+
+    S, C = np.shape(params["species_emb"]["w"])
+    H = np.shape(params["species_ref"]["w"])[0]
+    gain = _silu_2mom_gain()
+    rules: list[Rule] = []
+
+    def consume(name, validate=None):
+        if name in sd:
+            rules.append(Rule(name, None, validate))
+
+    def expect(name, value, what, atol=1e-6):
+        """Checkpoint constants must agree with the model config — a silent
+        mismatch (cutoff, envelope power, bessel frequencies) would evaluate
+        the converted weights with the wrong physics."""
+        def check(a, _v=np.asarray(value, dtype=np.float64)):
+            got = np.asarray(a, dtype=np.float64).reshape(_v.shape)
+            if not np.allclose(got, _v, atol=atol):
+                raise ValueError(
+                    f"checkpoint {what} = {got} does not match the model "
+                    f"config ({_v}); rebuild the model with matching "
+                    f"hyperparameters"
+                )
+        return check
+
+    cfg = model.cfg if model is not None else None
+    if cfg is None:
+        import warnings
+
+        warnings.warn(
+            "from_torch('mace', ...) called without model=: checkpoint "
+            "constants (cutoff, envelope power p, bessel frequencies, "
+            "avg_num_neighbors) will NOT be validated against the model "
+            "config — pass model=your_mace_instance",
+            stacklevel=3,
+        )
+
+    # model-level buffers
+    consume("atomic_numbers",
+            expect("atomic_numbers", cfg.atomic_numbers, "atomic_numbers")
+            if cfg is not None and cfg.atomic_numbers is not None else None)
+    consume("r_max", expect("r_max", cfg.cutoff, "r_max (cutoff)")
+            if cfg is not None else None)
+    for name in ("num_interactions", "heads"):
+        consume(name)
+
+    # embeddings
+    rules.append(Rule(
+        "node_embedding.linear.weight", ("species_emb", "w"),
+        lambda a: a.reshape(S, C) / np.sqrt(S),
+    ))
+    rules.append(Rule(
+        "atomic_energies_fn.atomic_energies", ("species_ref", "w"),
+        lambda a: np.broadcast_to(a.reshape(-1, S), (H, S)).copy(),
+    ))
+    consume(
+        "radial_embedding.bessel_fn.bessel_weights",
+        expect("bessel_weights",
+               np.pi * np.arange(1, cfg.num_bessel + 1),
+               "bessel frequencies (this framework's basis is fixed n*pi; a "
+               "checkpoint with trained frequencies cannot be represented)",
+               atol=1e-4)
+        if cfg is not None else None,
+    )
+    consume("radial_embedding.cutoff_fn.p",
+            expect("p", float(cfg.cutoff_p), "cutoff envelope power p")
+            if cfg is not None else None)
+    consume("radial_embedding.cutoff_fn.r_max",
+            expect("r_max", cfg.cutoff, "radial cutoff r_max")
+            if cfg is not None else None)
+
+    for t, inter in enumerate(params["interactions"]):
+        pre = f"interactions.{t}."
+        h_ls_in = sorted(int(l) for l in inter["lin_up"])
+        a_ls = tuple(sorted(int(l) for l in inter["lin_A"]))
+
+        # linear_up: flat per-l (C, C) blocks, alpha = 1/sqrt(C)
+        def up_tf(l_index, _h=tuple(h_ls_in)):
+            def tf(a):
+                blocks = a.reshape(len(_h), C, C)
+                return blocks[l_index] / np.sqrt(C)
+            return tf
+        for i, l in enumerate(h_ls_in):
+            rules.append(Rule(
+                pre + "linear_up.weight",
+                ("interactions", t, "lin_up", str(l), "w"), up_tf(i),
+            ))
+
+        # radial MLP (e3nn FullyConnectedNet): fold 1/sqrt(fan_in), the
+        # normalize2mom(silu) gain into post-activation layers, and — on the
+        # output layer — the per-path CG sign calibration exported by
+        # tools/export_upstream.py (__cg_sign__ entries), aligning e3nn's
+        # wigner_3j sign convention with real_clebsch_gordan's
+        n_layers = len(inter["radial"])
+        path_signs = _path_signs(
+            sd, inter, a_ls,
+            paths=model.msg_paths[t] if model is not None else None,
+        )
+        for li in range(n_layers):
+            key = pre + f"conv_tp_weights.layer{li}.weight"
+            g = (gain if li > 0 else 1.0)
+            d_in = np.shape(inter["radial"][li]["w"])[0]
+            if li == n_layers - 1 and path_signs is not None:
+                def last_tf(a, _g=g, _d=d_in, _s=path_signs):
+                    out = a * (_g / np.sqrt(_d))
+                    return (out.reshape(_d, len(_s), C)
+                            * _s[None, :, None]).reshape(_d, -1)
+                rules.append(Rule(
+                    key, ("interactions", t, "radial", li, "w"), last_tf,
+                ))
+            else:
+                rules.append(Rule(
+                    key, ("interactions", t, "radial", li, "w"),
+                    _scaled(g / np.sqrt(d_in)),
+                ))
+
+        # post-conv_tp linear: per-path (C, C) blocks in instruction order
+        # (sorted by output irrep — same order as lin_A's path axis),
+        # alpha = 1/sqrt(P_l * C)
+        offsets = {}
+        off = 0
+        for l in a_ls:
+            P_l = np.shape(inter["lin_A"][str(l)])[0]
+            offsets[l] = (off, P_l)
+            off += P_l
+        n_paths_tot = off
+
+        def lin_tf(l, _offsets=dict(offsets), _tot=n_paths_tot):
+            o, P_l = _offsets[l]
+            def tf(a):
+                blocks = a.reshape(_tot, C, C)
+                return blocks[o:o + P_l] / np.sqrt(P_l * C)
+            return tf
+        for l in a_ls:
+            rules.append(Rule(
+                pre + "linear.weight",
+                ("interactions", t, "lin_A", str(l)), lin_tf(l),
+            ))
+
+        # skip_tp (FullyConnectedTensorProduct with species one-hot):
+        # flat per-l (C, S, C) blocks, alpha = 1/sqrt(C * S)
+        res_ls = sorted(int(l) for l in inter["lin_res"])
+        def res_tf(l_index, _n=len(res_ls)):
+            def tf(a):
+                blocks = a.reshape(_n, C, S, C)
+                return blocks[l_index].transpose(1, 0, 2) / np.sqrt(C * S)
+            return tf
+        for i, l in enumerate(res_ls):
+            rules.append(Rule(
+                pre + "skip_tp.weight",
+                ("interactions", t, "lin_res", str(l)), res_tf(i),
+            ))
+        # (per-module output_mask buffers are consumed by the catch-all below)
+        consume(pre + "avg_num_neighbors",
+                expect("avg_num_neighbors", cfg.avg_num_neighbors,
+                       "avg_num_neighbors", atol=1e-3)
+                if cfg is not None else None)
+
+        # products: symmetric-contraction weights with exact U basis change
+        ppre = f"products.{t}."
+        out_ls = sorted(int(l) for l in inter["product"])
+        S_A = sum(2 * l + 1 for l in a_ls)
+        for i, l in enumerate(out_ls):
+            cpre = ppre + f"symmetric_contractions.contractions.{i}."
+            wts = inter["product"][str(l)]
+            nus = sorted(int(k[1:]) for k in wts)
+            numax = max(nus)
+
+            def prod_tf(l=l, nu=None, _a=a_ls, _cpre=cpre):
+                def tf(a):
+                    U_ours = symmetric_coupling_basis(_a, l, nu)
+                    u_flat = _find_u_buffer(sd, _cpre, S_A, nu)
+                    if u_flat is None:
+                        raise ValueError(
+                            f"no U_matrix buffer found under {_cpre!r} for "
+                            f"correlation {nu}; cannot basis-change the "
+                            f"symmetric-contraction weights. Export the "
+                            f"checkpoint with U buffers included."
+                        )
+                    T = _basis_change(U_ours, u_flat)
+                    return np.einsum("pq,zqc->zpc", T, a)
+                return tf
+
+            rules.append(Rule(
+                cpre + "weights_max",
+                ("interactions", t, "product", str(l), f"w{numax}"),
+                prod_tf(nu=numax),
+            ))
+            # lower correlations, descending, only for orders the model has
+            # (symmetric_coupling_basis can be empty for some (l, nu))
+            lower = [n for n in sorted(nus, reverse=True) if n != numax]
+            for j, nu in enumerate(lower):
+                rules.append(Rule(
+                    cpre + f"weights.{j}",
+                    ("interactions", t, "product", str(l), f"w{nu}"),
+                    prod_tf(nu=nu),
+                ))
+            # U buffers themselves are consumed (used via the transforms)
+            for key in list(sd):
+                if key.startswith(cpre) and (
+                    "U_matrix" in key or "U_tensors" in key
+                ):
+                    consume(key)
+
+        # product linear: per-l (C, C) blocks, alpha = 1/sqrt(C)
+        def msg_tf(l_index, _n=len(out_ls)):
+            def tf(a):
+                blocks = a.reshape(_n, C, C)
+                return blocks[l_index] / np.sqrt(C)
+            return tf
+        for i, l in enumerate(out_ls):
+            rules.append(Rule(
+                ppre + "linear.weight",
+                ("interactions", t, "lin_msg", str(l), "w"), msg_tf(i),
+            ))
+
+        # readouts
+        rpre = f"readouts.{t}."
+        if t == len(params["interactions"]) - 1:
+            d_mid = np.shape(inter["readout"][0]["w"])[1]
+            rules.append(Rule(
+                rpre + "linear_1.weight",
+                ("interactions", t, "readout", 0, "w"),
+                lambda a, _d=d_mid: a.reshape(C, _d) / np.sqrt(C),
+            ))
+            rules.append(Rule(
+                rpre + "linear_2.weight",
+                ("interactions", t, "readout", 1, "w"),
+                lambda a, _d=d_mid: a.reshape(_d, H) * (gain / np.sqrt(_d)),
+            ))
+        else:
+            rules.append(Rule(
+                rpre + "linear.weight",
+                ("interactions", t, "readout", 0, "w"),
+                lambda a: a.reshape(C, H) / np.sqrt(C),
+            ))
+
+    rules.append(Rule("scale_shift.scale", ("scale",),
+                      lambda a: np.broadcast_to(np.ravel(a), (H,)).copy()))
+    rules.append(Rule("scale_shift.shift", ("shift",),
+                      lambda a: np.broadcast_to(np.ravel(a), (H,)).copy()))
+
+    # optional ZBL pair repulsion
+    if "zbl" in params:
+        rules.append(Rule("pair_repulsion_fn.a_exp", ("zbl", "a_exp"),
+                          lambda a: a.reshape(())))
+        rules.append(Rule("pair_repulsion_fn.a_prefactor",
+                          ("zbl", "a_prefactor"), lambda a: a.reshape(())))
+        for name in ("pair_repulsion_fn.c", "pair_repulsion_fn.covalent_radii",
+                     "pair_repulsion_fn.p"):
+            consume(name)
+
+    # remaining bookkeeping entries: e3nn output masks, CG sign calibration
+    seen = {r.torch_name for r in rules}
+    for key in sd:
+        if key in seen:
+            continue
+        if key.endswith("output_mask") or key.startswith("__cg_sign__"):
+            consume(key)
+    return rules
+
+
+def from_torch(arch: str, state_dict: dict, params, strict: bool = True,
+               model=None):
+    """Map an upstream torch ``state_dict`` onto this framework's ``params``.
+
+    The reference's ``from_existing`` capability (chgnet.py:551-560,
+    models.py:252-263): the returned params evaluate the pretrained model.
+    Mappings receive the state dict too, so transforms can consult
+    checkpoint-borne constants (e.g. MACE's U-matrix buffers drive an exact
+    product-basis change). Pass ``model`` (the framework model instance) to
+    additionally validate checkpoint constants (cutoff, envelope power,
+    bessel frequencies, avg_num_neighbors) against the model config and to
+    resolve CG sign calibration unambiguously. strict=True fails loudly on
+    any unmapped tensor.
+    """
     if arch not in MAPPINGS:
         raise KeyError(f"no mapping registered for {arch!r}; have {sorted(MAPPINGS)}")
-    rules = MAPPINGS[arch](params)
+    rules = MAPPINGS[arch](params, state_dict, model)
     return convert(state_dict, params, rules, strict=strict)

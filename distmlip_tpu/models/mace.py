@@ -56,15 +56,22 @@ class MACEConfig:
     hidden_lmax: int = 1      # irreps of hidden node features (0..L)
     correlation: int = 3      # body order - 1 (ACE correlation)
     num_interactions: int = 2
+    scalar_last: bool = True  # upstream MACE keeps only scalar (l=0) hidden
+                              # features out of the final interaction/product
     num_bessel: int = 8
     radial_mlp: int = 64
     radial_layers: int = 3    # hidden layers in the radial MLP (upstream MACE
                               # uses [64, 64, 64], no biases)
-    radial_scale: float = 16.0  # output gain on the radial MLP: keeps the
-                                # density projection A healthy at init (the
-                                # cutoff envelope shrinks near-cutoff edges)
-                                # so correlation-2/3 products carry weight
+    radial_scale: float = 16.0  # INIT-time gain folded into the radial
+                                # MLP's output layer: keeps the density
+                                # projection A healthy at init (the cutoff
+                                # envelope shrinks near-cutoff edges) so
+                                # correlation-2/3 products carry weight.
+                                # Not applied at runtime — converted
+                                # upstream weights are used verbatim.
     cutoff: float = 5.0
+    cutoff_p: int = 6         # polynomial-envelope power (upstream MACE
+                              # checkpoints commonly use 5)
     avg_num_neighbors: float = 14.0
     num_heads: int = 1        # multi-head readouts (upstream MACE heads:
     head: int = 0             # per-head E0s/scale/shift/readout columns);
@@ -94,14 +101,16 @@ def _message_paths(h_ls, l_max, out_ls):
     harmonics carry SH parity, and upstream MACE's conv_tp keeps only the
     parity-consistent instructions, so odd-sum paths do not exist there —
     matching the path set (and radial-MLP output width) exactly is required
-    for weight parity."""
-    return [
+    for weight parity. Order matches upstream's instruction sort: by output
+    irrep first (stable within an l_out by enumeration order)."""
+    paths = [
         (lh, ly, lo)
         for lh in h_ls
         for ly in range(l_max + 1)
         for lo in out_ls
         if _triangle(lh, ly, lo) and (lh + ly + lo) % 2 == 0
     ]
+    return sorted(paths, key=lambda p: p[2])
 
 
 def _projection_tables(h_ls, l_max, paths):
@@ -159,14 +168,27 @@ class MACE:
         self.h_ls0 = [0]
         self.h_ls = list(range(c.hidden_lmax + 1))
         self.a_ls = list(range(c.a_lmax + 1))
-        self.msg_paths = []  # per interaction
+        # per-interaction input/output irrep sets: embeddings are scalar, the
+        # final layer emits scalars only when scalar_last (upstream MACE's
+        # "select only scalars for last layer")
+        self.h_ls_in: list[list[int]] = []
+        self.h_ls_out: list[list[int]] = []
+        prev = self.h_ls0
         for t in range(c.num_interactions):
-            h_ls = self.h_ls0 if t == 0 else self.h_ls
-            self.msg_paths.append(_message_paths(h_ls, c.l_max, self.a_ls))
-        self.proj = [
-            _projection_tables(
-                self.h_ls0 if t == 0 else self.h_ls, c.l_max, self.msg_paths[t]
+            self.h_ls_in.append(prev)
+            out = (
+                [0]
+                if (c.scalar_last and t == c.num_interactions - 1)
+                else self.h_ls
             )
+            self.h_ls_out.append(out)
+            prev = out
+        self.msg_paths = [
+            _message_paths(self.h_ls_in[t], c.l_max, self.a_ls)
+            for t in range(c.num_interactions)
+        ]
+        self.proj = [
+            _projection_tables(self.h_ls_in[t], c.l_max, self.msg_paths[t])
             for t in range(c.num_interactions)
         ]
         # ACE product basis: orthonormal symmetric U tensors per
@@ -200,18 +222,23 @@ class MACE:
             }
         for t in range(cfg.num_interactions):
             n_paths = len(self.msg_paths[t])
+            in_ls, out_ls = self.h_ls_in[t], self.h_ls_out[t]
             inter = {
                 # per-l channel mixing of the sender features
                 "lin_up": {
-                    str(l): linear_init_vp(next(ks), C, C)
-                    for l in (self.h_ls0 if t == 0 else self.h_ls)
+                    str(l): linear_init_vp(next(ks), C, C) for l in in_ls
                 },
-                "radial": mlp_init_vp(
+                # radial_scale is folded into the OUTPUT layer at init only;
+                # the forward pass applies the MLP verbatim (conversion
+                # overwrites these weights with upstream values unscaled)
+                "radial": (lambda r: r[:-1] + [
+                    {"w": r[-1]["w"] * cfg.radial_scale}
+                ])(mlp_init_vp(
                     next(ks),
                     [cfg.num_bessel]
                     + [cfg.radial_mlp] * cfg.radial_layers
                     + [n_paths * C],
-                ),
+                )),
                 # per-path output mixing (upstream MACE's post-conv_tp
                 # e3nn Linear: one C x C block per (path, l_out) pair)
                 "lin_A": {
@@ -234,25 +261,27 @@ class MACE:
                         for nu, U in self.prod_U[l].items()
                         if U is not None
                     }
-                    for l in self.h_ls
+                    for l in out_ls
                 },
                 "lin_msg": {
-                    str(l): linear_init_vp(next(ks), C, C) for l in self.h_ls
+                    str(l): linear_init_vp(next(ks), C, C) for l in out_ls
                 },
                 # species-dependent residual (upstream's skip_tp:
                 # FullyConnectedTensorProduct(h, species one-hot) — one C x C
-                # block per species per l)
+                # block per species per (l common to input and output)
                 "lin_res": {
                     str(l): jax.random.normal(
                         next(ks), (cfg.num_species, C, C)
                     )
                     / np.sqrt(C)
-                    for l in (self.h_ls0 if t == 0 else self.h_ls)
+                    for l in out_ls
+                    if l in in_ls
                 },
+                # bias-free like upstream's Linear/NonLinearReadoutBlock
                 "readout": (
-                    mlp_init(next(ks), [C, 16, cfg.num_heads])
+                    mlp_init(next(ks), [C, 16, cfg.num_heads], bias=False)
                     if t == cfg.num_interactions - 1
-                    else [linear_init(next(ks), C, cfg.num_heads)]
+                    else [linear_init(next(ks), C, cfg.num_heads, bias=False)]
                 ),
             }
             params["interactions"].append(inter)
@@ -282,8 +311,16 @@ class MACE:
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
         rhat = vec / jnp.maximum(d, 1e-9)[:, None]
-        env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
-        bessel = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel)
+        env = (
+            radial.polynomial_cutoff(d, cfg.cutoff, p=cfg.cutoff_p) * lg.edge_mask
+        ).astype(dtype)
+        # envelope multiplies the bessel features BEFORE the radial MLP
+        # (upstream's RadialEmbeddingBlock); the bias-free MLP maps 0 -> 0,
+        # so messages still vanish smoothly at the cutoff
+        bessel = (
+            radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel)
+            * env[:, None]
+        )
         Y = {l: spherical_harmonics(l, rhat) for l in range(cfg.l_max + 1)}
 
         z = lg.species
@@ -297,12 +334,12 @@ class MACE:
         acc = jnp.zeros(positions.shape[0], dtype=dtype)
 
         for t, inter in enumerate(params["interactions"]):
-            body = partial(self._interaction, lg=lg, Y=Y, bessel=bessel, env=env,
+            body = partial(self._interaction, lg=lg, Y=Y, bessel=bessel,
                            z=z, t=t)
             if cfg.remat:
                 body = jax.checkpoint(body)
             h = body(inter, h)
-            h = self._unpack(lg.halo_exchange(self._pack(h)), self.h_ls, C)
+            h = self._unpack(lg.halo_exchange(self._pack(h)), self.h_ls_out[t], C)
 
             # invariant readout (head column selected)
             scalars = h[0][:, :, 0]
@@ -337,15 +374,16 @@ class MACE:
             indices_are_sorted=True,
         )[:, 0]
 
-    def _interaction(self, inter, h, *, lg, Y, bessel, env, z, t):
+    def _interaction(self, inter, h, *, lg, Y, bessel, z, t):
         """One MACE interaction: density projection + symmetric contraction +
         linear update. Rematerialized under grad when cfg.remat (the per-edge
         per-path tensors dominate activation memory)."""
         cfg = self.cfg
         C = cfg.channels
-        dtype = env.dtype
+        dtype = bessel.dtype
         n_nodes = h[0].shape[0]
-        h_ls = self.h_ls0 if t == 0 else self.h_ls
+        h_ls = self.h_ls_in[t]
+        out_ls = self.h_ls_out[t]
         paths = self.msg_paths[t]
         proj = self.proj[t]
         Wp = jnp.asarray(proj["W"], dtype=dtype)          # (S_h*S_Y, Q)
@@ -389,15 +427,12 @@ class MACE:
         src_ch = pad_edge(lg.edge_src).reshape(K, chunk)
         dst_ch = pad_edge(lg.edge_dst).reshape(K, chunk)
         mask_ch = pad_c(lg.edge_mask).reshape(K, chunk)
-        env_ch = pad_c(env).reshape(K, chunk)
         bes_ch = pad_c(bessel).reshape(K, chunk, -1)
         Y_ch = pad_c(Y_full).reshape(K, chunk, -1)
 
         def chunk_body(A_acc, xs):
-            srcc, dstc, maskc, envc, Yc, besc = xs
-            Rc = mlp(inter["radial"], besc).reshape(chunk, len(paths), C) * (
-                cfg.radial_scale * envc
-            )[:, None, None]
+            srcc, dstc, maskc, Yc, besc = xs
+            Rc = mlp(inter["radial"], besc).reshape(chunk, len(paths), C)
             outer = hu[srcc][:, :, :, None] * Yc[:, None, None, :]
             M = outer.reshape(chunk, C, -1) @ Wp          # (E_c, C, Q) [MXU]
             M = M * jnp.swapaxes(Rc[:, q_path, :], 1, 2)  # per-path radial
@@ -412,13 +447,12 @@ class MACE:
         A0 = jnp.zeros((n_nodes, C, nQ), dtype=dtype)
         if K == 1:
             A_all, _ = chunk_body(
-                A0, (src_ch[0], dst_ch[0], mask_ch[0], env_ch[0], Y_ch[0],
-                     bes_ch[0])
+                A0, (src_ch[0], dst_ch[0], mask_ch[0], Y_ch[0], bes_ch[0])
             )
         else:
             body = jax.checkpoint(chunk_body) if cfg.remat else chunk_body
             A_all, _ = jax.lax.scan(
-                body, A0, (src_ch, dst_ch, mask_ch, env_ch, Y_ch, bes_ch)
+                body, A0, (src_ch, dst_ch, mask_ch, Y_ch, bes_ch)
             )
         # per-path output mixing on nodes (upstream's post-conv_tp linear):
         # A[l] = sum_paths A_all[:, :, cols(path)] @ W_path — (P_l*C) GEMMs
@@ -455,7 +489,7 @@ class MACE:
         def node_body(_, xs):
             Ac, zc, hc = xs
             outs = []
-            for l in self.h_ls:
+            for l in out_ls:
                 B = self._sym_contract(
                     inter["product"][str(l)], self.prod_U[l], Ac, zc, dtype
                 )
@@ -477,7 +511,7 @@ class MACE:
 
         h_new = {}
         o = 0
-        for l in self.h_ls:
+        for l in out_ls:
             d = 2 * l + 1
             h_new[l] = out_flat[..., o : o + d]
             o += d

@@ -45,11 +45,29 @@ def linear_init_vp(key, d_in: int, d_out: int):
     return {"w": jax.random.normal(key, (d_in, d_out)) / np.sqrt(d_in)}
 
 
-def mlp_init_vp(key, dims: list[int], act_gain: float = 1.679):
+def silu_2mom_gain() -> float:
+    """e3nn's normalize2mom(silu) constant: 1 / sqrt(E[silu(x)^2]), x~N(0,1),
+    by Gauss-Hermite quadrature. Single source of truth shared by the
+    variance-preserving init below and the torch-weight conversion folding
+    (models/convert.py)."""
+    global _SILU_GAIN
+    if _SILU_GAIN is None:
+        x, w = np.polynomial.hermite_e.hermegauss(201)
+        silu = x / (1.0 + np.exp(-x))
+        _SILU_GAIN = float(1.0 / np.sqrt(np.sum(w * silu**2) / np.sum(w)))
+    return _SILU_GAIN
+
+
+_SILU_GAIN = None
+
+
+def mlp_init_vp(key, dims: list[int], act_gain: float | None = None):
     """Bias-free variance-preserving MLP init (e3nn FullyConnectedNet
     convention): W ~ N(0, g^2/d_in), with g compensating silu's second
-    moment (E[silu(x)^2] ~ 0.355 under N(0,1) -> gain ~ 1.679) on layers
-    fed by an activation, so deep bias-free stacks keep O(1) outputs."""
+    moment (silu_2mom_gain) on layers fed by an activation, so deep
+    bias-free stacks keep O(1) outputs."""
+    if act_gain is None:
+        act_gain = silu_2mom_gain()
     keys = jax.random.split(key, len(dims) - 1)
     out = []
     for i, (k, a, b) in enumerate(zip(keys, dims[:-1], dims[1:])):

@@ -224,6 +224,36 @@ def symmetric_coupling_basis(a_ls: tuple, l_out: int, nu: int):
     (imposing e3nn's parity selection: paths with odd total l vanish).
     """
     a_ls = tuple(a_ls)
+    # disk cache next to this module (the analogue of the reference shipping
+    # precomputed Wigner tables, uma/Jd.pt): the (0..3, nu=3) bases take ~1
+    # min to build and are needed by every fresh process
+    import os
+
+    cache_dir = os.path.join(os.path.dirname(__file__), "_u_cache")
+    # v1 tags the construction algorithm (rng seed, tolerances, parity and
+    # ordering conventions); bump it on ANY change to this function so stale
+    # caches can never be served for a different basis
+    cache_key = os.path.join(
+        cache_dir, f"U_v1_{'-'.join(map(str, a_ls))}_{l_out}_{nu}.npy"
+    )
+    if os.path.exists(cache_key):
+        try:
+            arr = np.load(cache_key)
+            return None if arr.size == 0 else arr
+        except Exception:  # truncated/corrupt cache: rebuild below
+            pass
+
+    def _store(arr):
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            # tmp must end in .npy or np.save appends the suffix itself
+            tmp = cache_key + f".tmp{os.getpid()}.npy"
+            np.save(tmp, arr if arr is not None else np.zeros(0))
+            os.replace(tmp, cache_key)  # atomic: concurrent writers race safely
+        except OSError:  # read-only installs: stay in-memory (lru_cache)
+            pass
+        return arr
+
     S_A = sum(2 * l + 1 for l in a_ls)
     d_out = 2 * l_out + 1
     if S_A**nu > 50_000:
@@ -282,7 +312,7 @@ def symmetric_coupling_basis(a_ls: tuple, l_out: int, nu: int):
     _, s, Vt = np.linalg.svd(A, full_matrices=True)
     n_paths = int(np.sum(s < 1e-8))
     if n_paths == 0:
-        return None
+        return _store(None)
     null = Vt[-n_paths:]  # rows of Vt for (near-)zero singular values
     # guard the spectral gap so the path count is unambiguous
     if n_paths < dim_c and s[dim_c - n_paths - 1] < 1e-5:
@@ -292,7 +322,7 @@ def symmetric_coupling_basis(a_ls: tuple, l_out: int, nu: int):
         )
     U = (S @ null.reshape(n_paths, dim_sym, d_out).transpose(1, 2, 0).reshape(
         dim_sym, -1)).reshape((S_A,) * nu + (d_out, n_paths))
-    return np.ascontiguousarray(U)
+    return _store(np.ascontiguousarray(U))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,93 @@
+"""Export an upstream torch checkpoint into a portable npz for this
+framework's ``from_torch`` ingestion.
+
+Run this IN AN ENVIRONMENT WITH THE UPSTREAM PACKAGE INSTALLED (mace-torch /
+matgl); this image does not ship them. The reference's ``from_existing``
+wraps a live upstream module (mace/models.py:252-263); the TPU-native flow
+is instead: export once here, then load the npz anywhere:
+
+    # in a mace-torch environment
+    python -m distmlip_tpu.tools.export_upstream mace /path/to/model.pt out.npz
+
+    # in this framework (model= validates checkpoint constants vs the config)
+    sd = dict(np.load("out.npz"))
+    params, report = from_torch("mace", sd, model.init(key), model=model)
+
+The export includes every state-dict tensor AND buffer (mace's
+symmetric-contraction U matrices ride along as buffers, which is what makes
+the exact product-basis change in models/convert.py possible), plus a CG
+sign calibration: e3nn's wigner_3j and this framework's
+real_clebsch_gordan agree up to a per-(l1,l2,l3) sign, which is resolved
+here — where e3nn is importable — and recorded as ``__cg_sign__.{l1}.{l2}.{l3}``
+entries that the mace mapping folds into the radial-MLP output blocks.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def _cg_signs(l_max: int = 3) -> dict:
+    """Per-(l1,l2,l3) sign s with real_clebsch_gordan = s*sqrt(2l3+1)*w3j."""
+    try:
+        from e3nn import o3
+    except ImportError:
+        print("WARNING: e3nn not importable; CG sign calibration skipped "
+              "(conversion assumes matching sign conventions)")
+        return {}
+    from ..ops.so3 import real_clebsch_gordan
+
+    out = {}
+    for l1 in range(l_max + 1):
+        for l2 in range(l_max + 1):
+            for l3 in range(abs(l1 - l2), min(l1 + l2, l_max) + 1):
+                if (l1 + l2 + l3) % 2:
+                    continue
+                w3j = o3.wigner_3j(l1, l2, l3).numpy()
+                ours = real_clebsch_gordan(l1, l2, l3)
+                scaled = np.sqrt(2 * l3 + 1) * w3j
+                dot = float(np.sum(ours * scaled))
+                norm = float(np.sqrt(np.sum(ours**2) * np.sum(scaled**2)))
+                align = dot / max(norm, 1e-12)
+                if abs(abs(align) - 1.0) > 1e-4:
+                    # a ±1 calibration cannot represent this; exporting one
+                    # anyway would produce a silently wrong potential
+                    raise RuntimeError(
+                        f"CG ({l1},{l2},{l3}) bases differ beyond a sign "
+                        f"(|cos|={abs(align):.6f}); conversion needs a full "
+                        f"per-path basis alignment — please report this "
+                        f"combination"
+                    )
+                out[f"__cg_sign__.{l1}.{l2}.{l3}"] = np.array(
+                    1.0 if align >= 0 else -1.0
+                )
+    return out
+
+
+def export_mace(model_path: str, out_path: str) -> None:
+    import torch
+
+    model = torch.load(model_path, map_location="cpu", weights_only=False)
+    if hasattr(model, "models"):  # mace calculators wrap a list
+        model = model.models[0]
+    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    sd.update(_cg_signs(int(getattr(model, "max_ell", 3))))
+    np.savez_compressed(out_path, **sd)
+    print(f"exported {len(sd)} tensors -> {out_path}")
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 3 or argv[0] not in ("mace",):
+        print(__doc__)
+        print("usage: python -m distmlip_tpu.tools.export_upstream "
+              "mace <model.pt> <out.npz>")
+        return 2
+    export_mace(argv[1], argv[2])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,293 @@
+"""Weight ingestion: MACE mapping against synthetic upstream state dicts.
+
+The dicts use mace-torch ``ScaleShiftMACE.state_dict()`` tensor names and
+layouts (flat e3nn Linear weights, per-instruction blocks, U-matrix buffers)
+so the mapping is exercised exactly as it would be on a real MACE-MP-0
+checkpoint (reference capability: from_existing, mace/models.py:252-263).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distmlip_tpu.models import MACE, MACEConfig
+from distmlip_tpu.models.convert import _silu_2mom_gain, from_torch
+from distmlip_tpu.ops.so3 import symmetric_coupling_basis
+
+
+def _rand_orth(rng, n):
+    q, _ = np.linalg.qr(rng.normal(size=(n, n)))
+    return q
+
+
+def synthetic_mace_state_dict(model, rng):
+    """Build a state dict with upstream names/shapes for ``model``'s config."""
+    cfg = model.cfg
+    S, C, H = cfg.num_species, cfg.channels, cfg.num_heads
+    sd = {}
+    r = lambda *shape: rng.normal(size=shape).astype(np.float64)
+
+    sd["atomic_numbers"] = np.arange(1, S + 1)
+    sd["r_max"] = np.array(cfg.cutoff)
+    sd["num_interactions"] = np.array(cfg.num_interactions)
+    sd["node_embedding.linear.weight"] = r(S * C)
+    sd["atomic_energies_fn.atomic_energies"] = r(S)
+    sd["radial_embedding.bessel_fn.bessel_weights"] = (
+        np.pi * np.arange(1, cfg.num_bessel + 1)
+    )
+    sd["radial_embedding.cutoff_fn.p"] = np.array(float(cfg.cutoff_p))
+    sd["radial_embedding.cutoff_fn.r_max"] = np.array(cfg.cutoff)
+
+    a_ls = tuple(model.a_ls)
+    S_A = sum(2 * l + 1 for l in a_ls)
+    for t in range(cfg.num_interactions):
+        h_ls_in = model.h_ls_in[t]
+        h_ls_out = model.h_ls_out[t]  # scalars only in the last layer
+        res_ls = [l for l in h_ls_out if l in h_ls_in]
+        pre = f"interactions.{t}."
+        sd[pre + "linear_up.weight"] = r(len(h_ls_in) * C * C)
+        sd[pre + "linear_up.output_mask"] = np.ones(1)
+        dims = (
+            [cfg.num_bessel]
+            + [cfg.radial_mlp] * cfg.radial_layers
+            + [len(model.msg_paths[t]) * C]
+        )
+        for li, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+            sd[pre + f"conv_tp_weights.layer{li}.weight"] = r(a, b)
+        n_paths = len(model.msg_paths[t])
+        sd[pre + "linear.weight"] = r(n_paths * C * C)
+        sd[pre + "linear.output_mask"] = np.ones(1)
+        sd[pre + "skip_tp.weight"] = r(len(res_ls) * C * S * C)
+        sd[pre + "skip_tp.output_mask"] = np.ones(1)
+
+        ppre = f"products.{t}."
+        for i, l in enumerate(h_ls_out):
+            cpre = ppre + f"symmetric_contractions.contractions.{i}."
+            numax = cfg.correlation
+            for nu in range(1, numax + 1):
+                U = symmetric_coupling_basis(a_ls, l, nu)
+                k = U.shape[-1]
+                mix = _rand_orth(rng, k)
+                flat = U.reshape(-1, k) @ mix          # same span, new basis
+                d = 2 * l + 1
+                up = flat.reshape((S_A,) * nu + (d, k))
+                up = np.moveaxis(up, nu, 0)            # upstream: d leading
+                sd[cpre + f"U_matrix_{nu}"] = up
+                key = "weights_max" if nu == numax else (
+                    f"weights.{numax - 1 - nu}"
+                )
+                sd[cpre + key] = r(S, k, C)
+        sd[ppre + "linear.weight"] = r(len(h_ls_out) * C * C)
+        sd[ppre + "linear.output_mask"] = np.ones(1)
+
+        rpre = f"readouts.{t}."
+        if t == cfg.num_interactions - 1:
+            sd[rpre + "linear_1.weight"] = r(C * 16)
+            sd[rpre + "linear_2.weight"] = r(16 * H)
+            sd[rpre + "linear_1.output_mask"] = np.ones(1)
+            sd[rpre + "linear_2.output_mask"] = np.ones(1)
+        else:
+            sd[rpre + "linear.weight"] = r(C * H)
+            sd[rpre + "linear.output_mask"] = np.ones(1)
+
+    sd["scale_shift.scale"] = np.array(0.8)
+    sd["scale_shift.shift"] = np.array(-0.1)
+    if cfg.zbl:
+        sd["pair_repulsion_fn.a_exp"] = np.array(0.3)
+        sd["pair_repulsion_fn.a_prefactor"] = np.array(0.4543)
+        sd["pair_repulsion_fn.c"] = np.array([0.18175, 0.50986, 0.28022, 0.02817])
+        sd["pair_repulsion_fn.covalent_radii"] = np.zeros(119)
+        sd["pair_repulsion_fn.p"] = np.array(6.0)
+    return sd
+
+
+SMALL = MACEConfig(
+    num_species=5, channels=8, l_max=3, a_lmax=2, hidden_lmax=1,
+    correlation=3, num_interactions=2, num_bessel=6, radial_mlp=12,
+    cutoff=4.0, avg_num_neighbors=10.0, zbl=True,
+)
+
+
+def test_mace_mapping_full_coverage():
+    """Every tensor in a ScaleShiftMACE-shaped dict maps (zero unmapped)."""
+    rng = np.random.default_rng(0)
+    model = MACE(SMALL)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    sd = synthetic_mace_state_dict(model, rng)
+    params, report = from_torch("mace", sd, params, strict=True)
+    assert report["unused_torch"] == []
+    assert report["mapped"] == len(sd)
+
+
+def test_mace_mapping_numerics():
+    """Spot-check transforms: flat-linear reshape/normalization, radial
+    silu-gain folding, and EXACT U-basis change (the converted weights must
+    reproduce the upstream contraction tensor)."""
+    rng = np.random.default_rng(1)
+    model = MACE(SMALL)
+    cfg = SMALL
+    S, C = cfg.num_species, cfg.channels
+    params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    sd = synthetic_mace_state_dict(model, rng)
+    params, _ = from_torch("mace", sd, params, strict=True)
+
+    np.testing.assert_allclose(
+        params["species_emb"]["w"],
+        sd["node_embedding.linear.weight"].reshape(S, C) / np.sqrt(S),
+        rtol=1e-6,
+    )
+    gain = _silu_2mom_gain()
+    np.testing.assert_allclose(
+        params["interactions"][0]["radial"][1]["w"],
+        sd["interactions.0.conv_tp_weights.layer1.weight"]
+        * (gain / np.sqrt(cfg.radial_mlp)),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        params["interactions"][0]["radial"][0]["w"],
+        sd["interactions.0.conv_tp_weights.layer0.weight"]
+        / np.sqrt(cfg.num_bessel),
+        rtol=1e-6,
+    )
+    # U basis change: sum_q U_up[:, q] W_up[z, q, c] == sum_p U_ours W_conv
+    a_ls = tuple(model.a_ls)
+    S_A = sum(2 * l + 1 for l in a_ls)
+    for i, l in enumerate(model.h_ls):
+        cpre = f"products.0.symmetric_contractions.contractions.{i}."
+        for nu, key in ((3, "weights_max"), (2, "weights.0"), (1, "weights.1")):
+            U_ours = symmetric_coupling_basis(a_ls, l, nu)
+            k = U_ours.shape[-1]
+            up = np.moveaxis(sd[cpre + f"U_matrix_{nu}"], 0, nu)
+            up_flat = up.reshape(-1, k)
+            w_up = sd[cpre + key]
+            w_conv = params["interactions"][0]["product"][str(l)][f"w{nu}"]
+            lhs = np.einsum("fq,zqc->zfc", up_flat, w_up)
+            rhs = np.einsum("fp,zpc->zfc", U_ours.reshape(-1, k), w_conv)
+            np.testing.assert_allclose(lhs, rhs, atol=1e-8)
+    # scale/shift broadcast
+    np.testing.assert_allclose(params["scale"], [0.8])
+    np.testing.assert_allclose(params["shift"], [-0.1])
+    # zbl scalars
+    np.testing.assert_allclose(params["zbl"]["a_exp"], 0.3)
+
+
+def test_mace_mapping_mp0_medium_shapes():
+    """The VERDICT done-criterion: a MACE-MP-0-medium-shaped checkpoint
+    (89 elements, 128 channels, l_max 3, correlation 3, hidden 0e+1o,
+    interaction irreps to l=3, scalars-only final layer) maps with zero
+    unmapped tensors."""
+    cfg = MACEConfig(
+        num_species=89, channels=128, l_max=3, a_lmax=3, hidden_lmax=1,
+        correlation=3, num_interactions=2, num_bessel=8, radial_mlp=64,
+        cutoff=6.0, cutoff_p=5, avg_num_neighbors=35.0,
+    )
+    model = MACE(cfg)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    rng = np.random.default_rng(2)
+    sd = synthetic_mace_state_dict(model, rng)
+    params, report = from_torch("mace", sd, params, strict=True)
+    assert report["unused_torch"] == []
+
+
+def test_mace_mapping_missing_u_fails_loudly():
+    rng = np.random.default_rng(3)
+    model = MACE(SMALL)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    sd = synthetic_mace_state_dict(model, rng)
+    sd = {k: v for k, v in sd.items() if "U_matrix" not in k}
+    with pytest.raises(ValueError, match="U_matrix"):
+        from_torch("mace", sd, params, strict=False)
+
+
+def test_mace_mapping_cg_sign_calibration():
+    """__cg_sign__ entries flip the corresponding radial output blocks."""
+    rng = np.random.default_rng(4)
+    model = MACE(SMALL)
+    params0 = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    sd = synthetic_mace_state_dict(model, rng)
+    p_plain, _ = from_torch("mace", dict(sd), jax.device_get(
+        model.init(jax.random.PRNGKey(0))))
+    # full calibration coverage (partial coverage must raise — tested below),
+    # with one flipped path: (lh=0, ly=1, lo=1)
+    for t in range(SMALL.num_interactions):
+        for (lh, ly, lo) in model.msg_paths[t]:
+            sd[f"__cg_sign__.{lh}.{ly}.{lo}"] = np.array(1.0)
+    sd["__cg_sign__.0.1.1"] = np.array(-1.0)
+    p_cal, _ = from_torch("mace", sd, params0)
+    paths = model.msg_paths[0]
+    idx = paths.index((0, 1, 1))
+    C = SMALL.channels
+    w_plain = p_plain["interactions"][0]["radial"][-1]["w"].reshape(
+        SMALL.radial_mlp, len(paths), C)
+    w_cal = p_cal["interactions"][0]["radial"][-1]["w"].reshape(
+        SMALL.radial_mlp, len(paths), C)
+    np.testing.assert_allclose(w_cal[:, idx], -w_plain[:, idx], rtol=1e-6)
+    other = [i for i in range(len(paths)) if i != idx]
+    np.testing.assert_allclose(w_cal[:, other], w_plain[:, other], rtol=1e-6)
+
+
+def test_mace_mapping_validates_constants_with_model():
+    """With model passed, checkpoint constants that disagree with the config
+    (cutoff power, bessel frequencies) must fail loudly."""
+    rng = np.random.default_rng(5)
+    model = MACE(SMALL)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    sd = synthetic_mace_state_dict(model, rng)
+    # matching constants pass
+    from_torch("mace", dict(sd), jax.device_get(model.init(jax.random.PRNGKey(0))),
+               model=model)
+    bad = dict(sd)
+    bad["radial_embedding.cutoff_fn.p"] = np.array(5.0)  # config has 6
+    with pytest.raises(ValueError, match="envelope power"):
+        from_torch("mace", bad, params, model=model)
+    bad2 = dict(sd)
+    bad2["radial_embedding.bessel_fn.bessel_weights"] = (
+        sd["radial_embedding.bessel_fn.bessel_weights"] * 1.1)
+    with pytest.raises(ValueError, match="bessel"):
+        from_torch("mace", bad2, params, model=model)
+
+
+def test_radial_chain_matches_upstream_semantics():
+    """Evaluating our MLP with converted weights must equal e3nn's
+    FullyConnectedNet semantics applied to the raw upstream weights:
+    h -> nact(h @ W/sqrt(d_in)) per hidden layer (nact = normalize2mom silu),
+    final layer linear — on the SAME enveloped bessel input both sides."""
+    from distmlip_tpu.ops.nn import mlp
+
+    rng = np.random.default_rng(6)
+    model = MACE(SMALL)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    sd = synthetic_mace_state_dict(model, rng)
+    params, _ = from_torch("mace", sd, params, model=model)
+
+    x = rng.normal(size=(40, SMALL.num_bessel)) * 0.5
+    gain = _silu_2mom_gain()
+
+    def upstream_fcn(x, weights):
+        h = x
+        for i, w in enumerate(weights):
+            h = h @ (w / np.sqrt(w.shape[0]))
+            if i < len(weights) - 1:
+                hs = h / (1.0 + np.exp(-h))  # silu
+                h = gain * hs                # normalize2mom
+        return h
+
+    raw = [sd[f"interactions.0.conv_tp_weights.layer{i}.weight"]
+           for i in range(SMALL.radial_layers + 1)]
+    expected = upstream_fcn(x, raw)
+    got = np.asarray(mlp(params["interactions"][0]["radial"],
+                         np.asarray(x, np.float64)))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-6)
+
+
+def test_mace_mapping_partial_cg_calibration_raises():
+    """Calibration present but missing a path must fail loudly, not default
+    to +1."""
+    rng = np.random.default_rng(7)
+    model = MACE(SMALL)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    sd = synthetic_mace_state_dict(model, rng)
+    sd["__cg_sign__.0.0.0"] = np.array(1.0)  # one entry only
+    with pytest.raises(ValueError, match="no entry for"):
+        from_torch("mace", sd, params, model=model)

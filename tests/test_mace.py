@@ -125,22 +125,30 @@ def test_zbl_pair_repulsion(rng):
         cutoff=3.2, avg_num_neighbors=6.0, zbl=True,
         atomic_numbers=(14, 14, 8, 8),
     )
+    import dataclasses
+
     model = MACE(cfg)
+    model_nozbl = MACE(dataclasses.replace(cfg, zbl=False))
     params = model.init(jax.random.PRNGKey(0))
     lattice = np.eye(3) * 20.0
     species = np.zeros(2, np.int32)
 
-    def e_at(dd):
+    def zbl_at(dd):
+        """Isolated ZBL contribution: energy with minus without the term
+        (the learned potential's own slope would swamp a raw-ptp check)."""
         cart = np.array([[5.0, 5.0, 5.0], [5.0 + dd, 5.0, 5.0]])
-        e, _, _ = run_potential(model.energy_fn, params, cart, lattice,
-                                species, cfg.cutoff, 1, compute_stress=False)
-        return e
+        e_on, _, _ = run_potential(model.energy_fn, params, cart, lattice,
+                                   species, cfg.cutoff, 1, compute_stress=False)
+        e_off, _, _ = run_potential(model_nozbl.energy_fn, params, cart,
+                                    lattice, species, cfg.cutoff, 1,
+                                    compute_stress=False)
+        return e_on - e_off
 
     r_max = 2 * COVALENT_RADII[14]
-    assert e_at(0.6) - e_at(1.2) > 10.0          # strongly repulsive
+    assert zbl_at(0.6) - zbl_at(1.2) > 10.0      # strongly repulsive
     # smooth (continuous) across the ZBL cutoff
-    es = [e_at(d) for d in np.linspace(r_max - 0.02, r_max + 0.02, 7)]
-    assert np.ptp(es) < 1e-3
+    es = [zbl_at(d) for d in np.linspace(r_max - 0.02, r_max + 0.02, 7)]
+    assert np.ptp(es) < 1e-4
     # edge-level: exact zero beyond r_max
     v = zbl_edge_energy(jnp.asarray([14]), jnp.asarray([14]),
                         jnp.asarray([r_max + 0.01]))

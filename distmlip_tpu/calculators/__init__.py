@@ -1,13 +1,14 @@
 from .atoms import Atoms, KB, AMU_A2_FS2_TO_EV, EV_A3_TO_GPA
 from .elements import MASSES, SYMBOLS, symbols_to_numbers
-from .calculator import DistPotential, EnsemblePotential, make_ase_calculator
+from .calculator import (DistPotential, EnsemblePotential, UMAPredictor,
+                         make_ase_calculator)
 from .md import MolecularDynamics, TrajectoryObserver, ENSEMBLES
 from .relax import Relaxer, RelaxResult
 
 __all__ = [
     "Atoms", "KB", "AMU_A2_FS2_TO_EV", "EV_A3_TO_GPA",
     "MASSES", "SYMBOLS", "symbols_to_numbers",
-    "DistPotential", "EnsemblePotential", "make_ase_calculator",
+    "DistPotential", "EnsemblePotential", "UMAPredictor", "make_ase_calculator",
     "MolecularDynamics", "TrajectoryObserver", "ENSEMBLES",
     "Relaxer", "RelaxResult",
 ]

@@ -24,11 +24,15 @@ EV_A3_TO_GPA = 160.21766208
 
 class Atoms:
     def __init__(self, numbers=None, symbols=None, positions=None, cell=None,
-                 pbc=(True, True, True), velocities=None, masses=None):
+                 pbc=(True, True, True), velocities=None, masses=None,
+                 info=None):
         if numbers is None:
             if symbols is None:
                 raise ValueError("numbers or symbols required")
             numbers = symbols_to_numbers(symbols)
+        # free-form system metadata (ASE-compatible): UMA-style models read
+        # "charge", "spin", "dataset" from here
+        self.info = dict(info) if info else {}
         self.numbers = np.asarray(numbers, dtype=np.int32)
         self.positions = np.asarray(positions, dtype=np.float64).reshape(-1, 3).copy()
         self.cell = np.asarray(cell, dtype=np.float64).reshape(3, 3).copy()
@@ -55,6 +59,7 @@ class Atoms:
             numbers=self.numbers.copy(), positions=self.positions.copy(),
             cell=self.cell.copy(), pbc=self.pbc.copy(),
             velocities=self.velocities.copy(), masses=self.masses.copy(),
+            info=dict(self.info),
         )
 
     @property
@@ -88,6 +93,7 @@ class Atoms:
             cell=np.asarray(ase_atoms.get_cell()),
             pbc=ase_atoms.get_pbc(),
             masses=ase_atoms.get_masses(),
+            info=dict(getattr(ase_atoms, "info", {}) or {}),
         )
         try:
             # ASE time unit = Å sqrt(amu/eV) ≈ 10.1805 fs; convert to Å/fs

@@ -47,6 +47,15 @@ class ESCNConfig:
     num_experts: int = 1        # > 1 enables UMA-style MOLE weight mixing
     cutoff: float = 5.0
     avg_num_neighbors: float = 14.0
+    # UMA charge/spin/dataset (csd) conditioning (reference
+    # uma/escn_md.py:255-265): per-system embeddings mixed into the node
+    # scalars and the MOLE gate
+    num_charges: int = 25       # charge index = charge - charge_min
+    charge_min: int = -12
+    num_spins: int = 10
+    num_datasets: int = 4
+    edge_channels: int = 32     # source/target species embeddings feeding the
+                                # edge-degree embedding (ref escn_md.py:378-415)
     dtype: str = "float32"
 
     @property
@@ -89,10 +98,23 @@ class ESCN:
     def init(self, key) -> dict:
         cfg = self.cfg
         C, E = cfg.channels, cfg.num_experts
-        ks = iter(jax.random.split(key, 8 + cfg.num_layers * (4 * (cfg.l_max + 1) + 8)))
+        Ce = cfg.edge_channels
+        ks = iter(jax.random.split(key, 16 + cfg.num_layers * (4 * (cfg.l_max + 1) + 8)))
         params = {
             "species_emb": {"w": jax.random.normal(next(ks), (cfg.num_species, C))},
-            "mole_gate": mlp_init(next(ks), [C, C, E]) if E > 1 else None,
+            # csd conditioning: charge/spin/dataset embeddings mixed by an MLP
+            "charge_emb": {"w": jax.random.normal(next(ks), (cfg.num_charges, C))},
+            "spin_emb": {"w": jax.random.normal(next(ks), (cfg.num_spins, C))},
+            "dataset_emb": {"w": jax.random.normal(next(ks), (cfg.num_datasets, C))},
+            "csd_mlp": mlp_init(next(ks), [C, C]),
+            "sys_node_proj": linear_init(next(ks), C, C),
+            # edge-degree embedding: per-edge scalars -> m=0 coefficients
+            "source_emb": {"w": jax.random.normal(next(ks), (cfg.num_species, Ce))},
+            "target_emb": {"w": jax.random.normal(next(ks), (cfg.num_species, Ce))},
+            "edge_deg": linear_init(
+                next(ks), cfg.num_bessel + 2 * Ce, C * (cfg.l_max + 1)
+            ),
+            "mole_gate": mlp_init(next(ks), [2 * C, C, E]) if E > 1 else None,
             "layers": [],
             "energy_mlp": mlp_init(next(ks), [C, C, 1]),
             "species_ref": {"w": jnp.zeros((cfg.num_species,))},
@@ -152,20 +174,66 @@ class ESCN:
 
         z = lg.species
         zemb = params["species_emb"]["w"][z].astype(dtype)  # (N, C)
+
+        # csd (charge/spin/dataset) system embedding (ref escn_md.py:255-265)
+        sys_state = lg.system or {}
+        qi = jnp.clip(
+            jnp.asarray(sys_state.get("charge", 0)) - cfg.charge_min,
+            0, cfg.num_charges - 1,
+        )
+        si = jnp.clip(jnp.asarray(sys_state.get("spin", 0)), 0, cfg.num_spins - 1)
+        di = jnp.clip(
+            jnp.asarray(sys_state.get("dataset", 0)), 0, cfg.num_datasets - 1
+        )
+        csd = mlp(
+            params["csd_mlp"],
+            (
+                params["charge_emb"]["w"][qi]
+                + params["spin_emb"]["w"][si]
+                + params["dataset_emb"]["w"][di]
+            ).astype(dtype),
+        )  # (C,)
+
         h = jnp.zeros((positions.shape[0], C, S), dtype=dtype)
-        h = h.at[:, :, 0].set(zemb)
+        # node scalars: species embedding + the system (csd) embedding
+        # (ref escn_md.py:330 x_message[:, 0, :] += sys_node_embedding)
+        h = h.at[:, :, 0].set(zemb + linear(params["sys_node_proj"], csd)[None, :])
+
+        # edge-degree embedding: per-edge scalars (distance expansion +
+        # source/target species embeddings) -> m=0 coefficients in the edge
+        # frame, rotated back and degree-summed onto the receiver
+        # (ref escn_md.py:378-415)
+        x_edge = jnp.concatenate(
+            [
+                bessel,
+                params["source_emb"]["w"][z[lg.edge_src]].astype(dtype),
+                params["target_emb"]["w"][z[lg.edge_dst]].astype(dtype),
+            ],
+            axis=-1,
+        )
+        w_deg = linear(params["edge_deg"], x_edge).reshape(-1, C, cfg.l_max + 1)
+        y_deg = jnp.zeros((w_deg.shape[0], C, S), dtype=dtype)
+        for l in range(cfg.l_max + 1):
+            y_deg = y_deg.at[:, :, l * l + l].set(w_deg[:, :, l])  # (l, m=0)
+        deg_msg = rotate(y_deg, transpose=True) * env[:, None, None]
+        h = h + masked_segment_sum(
+            deg_msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
+            indices_are_sorted=True,
+        ) * jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)
         h = lg.halo_exchange(h)
 
-        # MOLE coefficients: whole-system composition embedding -> softmax.
-        # Globally consistent across partitions (psum'd mean), replicated —
-        # the TPU version of the reference's replicated MOLE coefficients.
+        # MOLE coefficients: whole-system composition embedding + csd ->
+        # softmax gate. Globally consistent across partitions (psum'd mean),
+        # replicated — the TPU version of the reference's replicated MOLE
+        # coefficients with its csd-driven gating (escn_md.py:255-265,343-357)
         if cfg.num_experts > 1:
             owned = lg.owned_mask.astype(dtype)[:, None]
             comp_sum = lg.psum(jnp.sum(zemb * owned, axis=0))
             count = lg.psum(jnp.sum(owned))
-            mole = jax.nn.softmax(
-                mlp(params["mole_gate"], comp_sum / jnp.maximum(count, 1.0))
-            )  # (E_experts,)
+            gate_in = jnp.concatenate(
+                [comp_sum / jnp.maximum(count, 1.0), csd], axis=-1
+            )
+            mole = jax.nn.softmax(mlp(params["mole_gate"], gate_in))
         else:
             mole = jnp.ones((1,), dtype=dtype)
 

@@ -75,6 +75,7 @@ class LocalGraph:
     bond_halo_send_idx: Any = None
     bond_halo_send_mask: Any = None
     bond_halo_recv_idx: Any = None
+    system: Any = None  # replicated per-system scalars (charge/spin/dataset)
 
     # ---- collectives ----
     def halo_exchange(self, feats):
@@ -165,5 +166,6 @@ def local_graph_from_stacked(g, axis_name: str | None) -> tuple[LocalGraph, Any]
         bond_halo_send_idx=g.bond_halo_send_idx[:, 0],
         bond_halo_send_mask=g.bond_halo_send_mask[:, 0],
         bond_halo_recv_idx=g.bond_halo_recv_idx[:, 0],
+        system=g.system,
     )
     return lg, sq(g.positions)

@@ -46,6 +46,7 @@ def graph_in_specs(graph: PartitionedGraph) -> PartitionedGraph:
         edge_src=row, edge_dst=row, edge_offset=row, edge_mask=row,
         halo_send_idx=table, halo_send_mask=table, halo_recv_idx=table,
         lattice=rep, n_total_nodes=rep,
+        system=None if graph.system is None else {k: rep for k in graph.system},
         line_src=row, line_dst=row, line_mask=row, line_center=row,
         bond_map_edge=row, bond_map_bond=row, bond_map_mask=row,
         bond_halo_send_idx=table, bond_halo_send_mask=table,

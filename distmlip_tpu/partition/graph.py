@@ -49,6 +49,7 @@ _default_caps = CapacityPolicy()
         "bond_halo_send_mask",
         "bond_halo_recv_idx",
         "n_total_nodes",
+        "system",
     ],
     meta_fields=["num_partitions", "shifts", "has_bond_graph", "n_cap", "e_cap", "b_cap"],
 )
@@ -89,6 +90,9 @@ class PartitionedGraph:
     bond_halo_send_idx: Any # (S, P, BH_cap)
     bond_halo_send_mask: Any
     bond_halo_recv_idx: Any
+    # per-system replicated scalars (UMA charge/spin/dataset conditioning,
+    # reference uma/escn_md.py:255-265)
+    system: Any = None      # {"charge","spin","dataset"}: () int32 each
 
 
 @dataclass
@@ -160,8 +164,14 @@ def build_partitioned_graph(
     lattice: np.ndarray,
     caps: CapacityPolicy | None = None,
     dtype=np.float32,
+    system: dict | None = None,
 ) -> tuple[PartitionedGraph, HostGraphData]:
-    """Pad + stack a PartitionPlan into a PartitionedGraph pytree."""
+    """Pad + stack a PartitionPlan into a PartitionedGraph pytree.
+
+    ``system``: optional per-system scalars (charge, spin, dataset ints) —
+    conditioning inputs for UMA-style models; defaults to zeros so the pytree
+    structure is stable.
+    """
     caps = caps or _default_caps
     P = plan.num_partitions
     n_cap = caps.get("nodes", max(int(m[-1]) for m in plan.node_markers))
@@ -297,6 +307,11 @@ def build_partitioned_graph(
         bond_halo_send_idx=b_send,
         bond_halo_send_mask=b_smask,
         bond_halo_recv_idx=b_recv,
+        system={
+            "charge": np.int32((system or {}).get("charge", 0)),
+            "spin": np.int32((system or {}).get("spin", 0)),
+            "dataset": np.int32((system or {}).get("dataset", 0)),
+        },
     )
     host = HostGraphData(plan=plan, global_ids=plan.global_ids, owned_counts=owned_counts)
     return graph, host

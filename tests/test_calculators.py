@@ -168,3 +168,83 @@ def test_relaxer_lbfgs(rng, potential):
     atoms = make_atoms(rng, noise=0.12)
     out = Relaxer(potential, optimizer="lbfgs", fmax=0.05).relax(atoms, steps=200)
     assert out.converged and np.abs(out.forces).max() < 0.05
+
+
+def test_stacked_ensemble_matches_sequential(rng):
+    """Single-partition ensembles evaluate all members in one vmapped
+    program; results must equal the sequential path."""
+    import jax
+
+    from distmlip_tpu.calculators import Atoms, EnsemblePotential
+    from distmlip_tpu.models import TensorNet, TensorNetConfig
+
+    cfg = TensorNetConfig(num_species=8, units=16, num_rbf=6, num_layers=1,
+                          cutoff=3.2)
+    model = TensorNet(cfg)
+    plist = [model.init(jax.random.PRNGKey(i)) for i in range(3)]
+    cart, lattice, species, _ = __import__("tests.conftest", fromlist=["random_cell"]).random_cell(
+        rng, n_atoms=24, box=8.0)
+    atoms = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+    stacked = EnsemblePotential(model, plist, num_partitions=1, stacked=True)
+    seq = EnsemblePotential(model, plist, num_partitions=1, stacked=False)
+    r1 = stacked.calculate(atoms)
+    r2 = seq.calculate(atoms)
+    assert abs(r1["energy"] - r2["energy"]) < 1e-5
+    np.testing.assert_allclose(r1["forces"], r2["forces"], atol=1e-5)
+    np.testing.assert_allclose(r1["energy_var"], r2["energy_var"], rtol=1e-4,
+                               atol=1e-8)
+
+
+def test_uma_predictor_task_routing(rng):
+    """UMAPredictor: task name routes the dataset conditioning; different
+    tasks give different energies on the same structure."""
+    import jax
+
+    from distmlip_tpu.calculators import Atoms, UMAPredictor
+    from distmlip_tpu.models import ESCN, ESCNConfig
+
+    cfg = ESCNConfig(num_species=8, channels=8, l_max=1, num_layers=1,
+                     num_bessel=4, cutoff=3.2)
+    model = ESCN(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    cart, lattice, species, _ = __import__("tests.conftest", fromlist=["random_cell"]).random_cell(
+        rng, n_atoms=20, box=8.0)
+    atoms = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+    e_omat = UMAPredictor(model, params, task_name="omat",
+                          num_partitions=1).calculate(atoms)["energy"]
+    e_oc20 = UMAPredictor(model, params, task_name="oc20",
+                          num_partitions=1).calculate(atoms)["energy"]
+    assert abs(e_omat - e_oc20) > 1e-7
+    # explicit atoms.info dataset wins over the task default
+    atoms2 = atoms.copy()
+    atoms2.info["dataset"] = 2
+    e_override = UMAPredictor(model, params, task_name="omat",
+                              num_partitions=1).calculate(atoms2)["energy"]
+    assert abs(e_override - e_oc20) < 1e-6
+
+
+def test_out_of_range_system_scalars_raise(rng):
+    """Charge/spin/dataset outside the embedding tables must raise instead of
+    silently clipping onto the table edge."""
+    import jax
+
+    import pytest as _pytest
+
+    from distmlip_tpu.calculators import Atoms, DistPotential
+    from distmlip_tpu.models import ESCN, ESCNConfig
+
+    cfg = ESCNConfig(num_species=8, channels=8, l_max=1, num_layers=1,
+                     num_bessel=4, cutoff=3.2)
+    model = ESCN(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    cart, lattice, species, _ = __import__("tests.conftest", fromlist=["random_cell"]).random_cell(
+        rng, n_atoms=12, box=8.0)
+    pot = DistPotential(model, params, num_partitions=1,
+                        species_map=np.arange(0, 10, dtype=np.int32) - 1)
+    atoms = Atoms(numbers=species + 1, positions=cart, cell=lattice,
+                  info={"charge": 99})
+    with _pytest.raises(ValueError, match="charge"):
+        pot.calculate(atoms)
+    atoms.info = {"dataset": 7}
+    with _pytest.raises(ValueError, match="dataset"):
+        pot.calculate(atoms)

@@ -115,3 +115,42 @@ def test_antiparallel_edges_equivariance(rng, params):
                               species, CFG.cutoff, 1, compute_stress=False)
     assert abs(e1 - e2) < 1e-3 * max(1.0, abs(e1))
     np.testing.assert_allclose(f1 @ q, f2, atol=5e-4)
+
+
+def test_charge_spin_dataset_change_energy(rng, params):
+    """UMA csd conditioning: charge, spin, and dataset must each change the
+    energy (ref escn_md.py:255-265)."""
+    from distmlip_tpu.neighbors import neighbor_list_numpy
+    from distmlip_tpu.parallel import make_potential_fn
+    from distmlip_tpu.partition import build_plan, build_partitioned_graph
+
+    cart, lattice, species = make_crystal(rng, reps=(2, 2, 2))
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], CFG.cutoff)
+    plan = build_plan(nl, lattice, [1, 1, 1], 1, CFG.cutoff)
+    pot = make_potential_fn(MODEL.energy_fn, None, compute_stress=False)
+
+    def e_with(**system):
+        graph, _ = build_partitioned_graph(plan, nl, species, lattice,
+                                           system=system)
+        return float(pot(params, graph, graph.positions)["energy"])
+
+    e0 = e_with()
+    assert abs(e_with(charge=2) - e0) > 1e-6
+    assert abs(e_with(spin=3) - e0) > 1e-6
+    assert abs(e_with(dataset=1) - e0) > 1e-6
+
+
+def test_edge_degree_embedding_contributes(rng, params):
+    """Zeroing the edge-degree projection must change the energy
+    (ref escn_md.py:378-415)."""
+    import copy
+
+    cart, lattice, species = make_crystal(rng, reps=(2, 2, 2))
+    e1, _, _ = run_potential(MODEL.energy_fn, params, cart, lattice, species,
+                             CFG.cutoff, 1, compute_stress=False)
+    p0 = copy.deepcopy(jax.device_get(params))
+    p0["edge_deg"]["w"] = p0["edge_deg"]["w"] * 0.0
+    p0["edge_deg"]["b"] = p0["edge_deg"]["b"] * 0.0
+    e2, _, _ = run_potential(MODEL.energy_fn, p0, cart, lattice, species,
+                             CFG.cutoff, 1, compute_stress=False)
+    assert abs(e1 - e2) > 1e-5

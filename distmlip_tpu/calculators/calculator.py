@@ -55,9 +55,34 @@ class DistPotential:
         compute_stress: bool = True,
         caps: CapacityPolicy | None = None,
         skin: float = 0.0,
+        compute_dtype: str | None = None,
     ):
         import jax
 
+        if compute_dtype is None:
+            # fall back to the process-global switch (set_compute_dtype),
+            # restricted to models that actually honor cfg.dtype
+            from .. import _compute_dtype as _global_dtype
+
+            if _global_dtype != "float32" and getattr(
+                model, "supports_compute_dtype", False
+            ):
+                compute_dtype = _global_dtype
+        if compute_dtype is not None and compute_dtype != getattr(
+            model.cfg, "dtype", None
+        ):
+            if not getattr(model, "supports_compute_dtype", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not implement a compute-"
+                    f"dtype switch (its energy_fn ignores cfg.dtype); "
+                    f"compute_dtype={compute_dtype!r} would silently run fp32"
+                )
+            # one-call precision switch: rebuild the model with the requested
+            # compute dtype (bfloat16 runs the GEMMs at MXU-native precision;
+            # geometry and energy accumulation stay in fp32)
+            import dataclasses
+
+            model = type(model)(dataclasses.replace(model.cfg, dtype=compute_dtype))
         self.model = model
         self.params = params
         devices = list(devices if devices is not None else jax.devices())

@@ -88,6 +88,8 @@ def _m_index(l_max):
 
 
 class ESCN:
+    supports_compute_dtype = True  # energy_fn honors cfg.dtype="bfloat16"
+
     def __init__(self, config: ESCNConfig = ESCNConfig()):
         if config.l_max > 6:
             raise NotImplementedError("l_max > 6: extend ops/so3 normalizations")
@@ -149,13 +151,36 @@ class ESCN:
     def energy_fn(self, params, lg, positions):
         cfg = self.cfg
         C, S = cfg.channels, cfg.sphere_dim
-        dtype = positions.dtype
+        # compute dtype for features/SO(2) GEMMs (cfg.dtype="bfloat16");
+        # geometry and the final energy sum stay in the positions dtype
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else positions.dtype
+        if cfg.dtype == "bfloat16":
+            # cast the GEMM-bearing subtrees only: species_ref (O(10-100) eV
+            # reference energies) and the energy readout stay fp32 so the
+            # energy path keeps full precision. The cast is O(param bytes)
+            # per step — negligible next to the edge activations.
+            keep_fp32 = ("species_ref", "energy_mlp")
+            params = {
+                k: (
+                    v
+                    if k in keep_fp32
+                    else jax.tree.map(
+                        lambda x: x.astype(dtype)
+                        if hasattr(x, "dtype")
+                        and jnp.issubdtype(x.dtype, jnp.floating)
+                        else x,
+                        v,
+                    )
+                )
+                for k, v in params.items()
+            }
 
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
         rhat = vec / jnp.maximum(d, 1e-9)[:, None]
         env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
-        bessel = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel)
+        bessel = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel
+                                               ).astype(dtype)
 
         # edge-frame Wigner matrices, block-diagonal over l, as one (E,S,S)
         R_edge = rotation_to_z(rhat)
@@ -166,7 +191,7 @@ class ESCN:
             # hvecs: (E, C, S) in source frame -> rotated per l block
             parts = []
             for l in range(cfg.l_max + 1):
-                Dl = D[l]
+                Dl = D[l].astype(hvecs.dtype)
                 if transpose:
                     Dl = jnp.swapaxes(Dl, -1, -2)
                 parts.append(jnp.einsum("epq,ecq->ecp", Dl, hvecs[:, :, sl[l]]))
@@ -279,5 +304,6 @@ class ESCN:
             h = h + upd
             h = lg.halo_exchange(h)
 
-        e_atom = mlp(params["energy_mlp"], h[:, :, 0])[:, 0]
-        return e_atom + params["species_ref"]["w"][z].astype(dtype)
+        # energy sum in the positions dtype (bf16 is too coarse for it)
+        e_atom = mlp(params["energy_mlp"], h[:, :, 0])[:, 0].astype(positions.dtype)
+        return e_atom + params["species_ref"]["w"][z].astype(positions.dtype)

@@ -158,6 +158,8 @@ def _projection_tables(h_ls, l_max, paths):
 
 
 class MACE:
+    supports_compute_dtype = True  # energy_fn honors cfg.dtype="bfloat16"
+
     def __init__(self, config: MACEConfig = MACEConfig()):
         self.cfg = config
         c = config
@@ -306,7 +308,14 @@ class MACE:
     def energy_fn(self, params, lg, positions):
         cfg = self.cfg
         C = cfg.channels
-        dtype = positions.dtype
+        # geometry stays in the positions dtype; features/messages run in the
+        # configured compute dtype (cfg.dtype="bfloat16" puts every GEMM on
+        # the MXU's native precision); per-atom energy terms accumulate in
+        # the positions dtype below
+        dtype = (
+            jnp.bfloat16 if cfg.dtype == "bfloat16" else positions.dtype
+        )
+        acc_dtype = positions.dtype
 
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
@@ -320,18 +329,20 @@ class MACE:
         bessel = (
             radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel)
             * env[:, None]
-        )
+        ).astype(dtype)
         Y = {l: spherical_harmonics(l, rhat) for l in range(cfg.l_max + 1)}
 
         z = lg.species
-        h = {0: params["species_emb"]["w"][z][:, :, None]}  # (N, C, 1)
+        h = {0: params["species_emb"]["w"][z][:, :, None].astype(dtype)}
         h = self._unpack(lg.halo_exchange(self._pack(h)), [0], C)
 
         head = cfg.head
-        e_site = params["species_ref"]["w"][head][z].astype(dtype)
+        # site/readout energies accumulate in the positions dtype: bf16 has
+        # too few mantissa bits for per-atom energy sums
+        e_site = params["species_ref"]["w"][head][z].astype(acc_dtype)
         if cfg.zbl:
-            e_site = e_site + self._zbl_site(params, lg, d, dtype)
-        acc = jnp.zeros(positions.shape[0], dtype=dtype)
+            e_site = e_site + self._zbl_site(params, lg, d, acc_dtype)
+        acc = jnp.zeros(positions.shape[0], dtype=acc_dtype)
 
         for t, inter in enumerate(params["interactions"]):
             body = partial(self._interaction, lg=lg, Y=Y, bessel=bessel,
@@ -344,12 +355,13 @@ class MACE:
             # invariant readout (head column selected)
             scalars = h[0][:, :, 0]
             if t == cfg.num_interactions - 1:
-                acc = acc + mlp(inter["readout"], scalars)[:, head]
+                r_out = mlp(inter["readout"], scalars)[:, head]
             else:
-                acc = acc + linear(inter["readout"][0], scalars)[:, head]
+                r_out = linear(inter["readout"][0], scalars)[:, head]
+            acc = acc + r_out.astype(acc_dtype)
 
-        scale = params["scale"][head].astype(dtype)
-        shift = params["shift"][head].astype(dtype)
+        scale = params["scale"][head].astype(acc_dtype)
+        shift = params["shift"][head].astype(acc_dtype)
         return e_site + scale * acc + shift
 
     def _zbl_site(self, params, lg, d, dtype):
@@ -381,6 +393,16 @@ class MACE:
         cfg = self.cfg
         C = cfg.channels
         dtype = bessel.dtype
+        # run the whole interaction in the compute dtype: cast the parameter
+        # subtree so mixed-precision promotion can't silently upcast the
+        # GEMMs back to fp32 (O(param bytes) per step — negligible next to
+        # the per-edge activations; a no-op when params are already cast)
+        inter = jax.tree.map(
+            lambda x: x.astype(dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            inter,
+        )
         n_nodes = h[0].shape[0]
         h_ls = self.h_ls_in[t]
         out_ls = self.h_ls_out[t]

@@ -248,3 +248,70 @@ def test_out_of_range_system_scalars_raise(rng):
     atoms.info = {"dataset": 7}
     with _pytest.raises(ValueError, match="dataset"):
         pot.calculate(atoms)
+
+
+def test_bfloat16_one_call_switch(rng):
+    """DistPotential(compute_dtype='bfloat16') runs end to end; energies and
+    forces stay close to fp32 (characterizes the bf16 error)."""
+    import jax
+
+    from distmlip_tpu.calculators import Atoms, DistPotential
+    from distmlip_tpu.models import MACE, MACEConfig
+
+    cfg = MACEConfig(num_species=8, channels=16, l_max=2, a_lmax=2,
+                     hidden_lmax=1, correlation=3, num_interactions=2,
+                     num_bessel=6, radial_mlp=16, cutoff=3.2,
+                     avg_num_neighbors=12.0)
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    from tests.utils import make_crystal
+
+    cart, lattice, species = make_crystal(rng, reps=(3, 3, 3), n_species=8)
+    atoms = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+    smap = np.arange(0, 10, dtype=np.int32) - 1
+
+    r32 = DistPotential(model, params, num_partitions=1,
+                        species_map=smap).calculate(atoms)
+    r16 = DistPotential(model, params, num_partitions=1, species_map=smap,
+                        compute_dtype="bfloat16").calculate(atoms)
+    n = len(atoms)
+    de_per_atom = abs(r16["energy"] - r32["energy"]) / n
+    f_scale = max(np.abs(r32["forces"]).max(), 1e-3)
+    df_rel = np.abs(r16["forces"] - r32["forces"]).max() / f_scale
+    print(f"bf16 vs fp32: dE={de_per_atom:.2e} eV/atom, "
+          f"dF_rel={df_rel:.2e}")
+    assert de_per_atom < 5e-3
+    assert df_rel < 0.1
+
+
+def test_compute_dtype_guards(rng):
+    """Unsupported models must reject compute_dtype loudly; the global
+    set_compute_dtype switch routes into supporting models."""
+    import jax
+
+    import distmlip_tpu
+    import pytest as _pytest
+
+    from distmlip_tpu.calculators import DistPotential
+    from distmlip_tpu.models import TensorNet, TensorNetConfig
+
+    model = TensorNet(TensorNetConfig(num_species=4, units=8, num_rbf=4,
+                                      num_layers=1))
+    params = model.init(jax.random.PRNGKey(0))
+    with _pytest.raises(ValueError, match="compute"):
+        DistPotential(model, params, num_partitions=1,
+                      compute_dtype="bfloat16")
+    # global switch is ignored (without error) for unsupported models...
+    distmlip_tpu.set_compute_dtype("bfloat16")
+    try:
+        DistPotential(model, params, num_partitions=1)
+        # ...and picked up by supporting ones
+        from distmlip_tpu.models import MACE, MACEConfig
+
+        m = MACE(MACEConfig(num_species=4, channels=8, l_max=1, a_lmax=1,
+                            hidden_lmax=1, correlation=2, num_interactions=1,
+                            num_bessel=4, radial_mlp=8))
+        pot = DistPotential(m, m.init(jax.random.PRNGKey(0)), num_partitions=1)
+        assert pot.model.cfg.dtype == "bfloat16"
+    finally:
+        distmlip_tpu.set_compute_dtype("float32")

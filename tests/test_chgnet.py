@@ -66,21 +66,14 @@ def test_forces_match_finite_difference(rng, params):
         cart = cart.astype(np.float64)
 
         def energy(c):
-            from distmlip_tpu.neighbors import neighbor_list_numpy
-            from distmlip_tpu.parallel import make_potential_fn
-            from distmlip_tpu.partition import build_plan, build_partitioned_graph
-
-            nl = neighbor_list_numpy(c, lattice, [1, 1, 1], CFG.cutoff,
-                                     bond_r=CFG.bond_cutoff)
-            plan = build_plan(nl, lattice, [1, 1, 1], 1, CFG.cutoff,
-                              CFG.bond_cutoff, use_bond_graph=True)
-            graph, host = build_partitioned_graph(plan, nl, species, lattice,
-                                                  dtype=np.float64)
-            pot = make_potential_fn(MODEL.energy_fn, None, compute_stress=False)
-            out = pot(jax.tree.map(lambda x: x.astype(np.float64), params),
-                      graph, graph.positions)
-            return float(out["energy"]), host.gather_owned(
-                np.asarray(out["forces"]), len(c))
+            e, f, _ = run_potential(
+                MODEL.energy_fn,
+                jax.tree.map(lambda x: jax.numpy.asarray(x, jax.numpy.float64), params),
+                c, lattice, species, CFG.cutoff, 1,
+                bond_r=CFG.bond_cutoff, use_bond_graph=True,
+                compute_stress=False, dtype=np.float64,
+            )
+            return e, f
 
         _, forces = energy(cart)
         assert np.abs(forces).max() > 1e-2

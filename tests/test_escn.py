@@ -66,19 +66,13 @@ def test_forces_match_finite_difference(rng, params):
         cart = cart.astype(np.float64)
 
         def energy(c):
-            from distmlip_tpu.neighbors import neighbor_list_numpy
-            from distmlip_tpu.parallel import make_potential_fn
-            from distmlip_tpu.partition import build_plan, build_partitioned_graph
-
-            nl = neighbor_list_numpy(c, lattice, [1, 1, 1], CFG.cutoff)
-            plan = build_plan(nl, lattice, [1, 1, 1], 1, CFG.cutoff)
-            graph, host = build_partitioned_graph(plan, nl, species, lattice,
-                                                  dtype=np.float64)
-            pot = make_potential_fn(MODEL.energy_fn, None, compute_stress=False)
-            out = pot(jax.tree.map(lambda x: jax.numpy.asarray(x, jax.numpy.float64), params),
-                      graph, graph.positions)
-            return float(out["energy"]), host.gather_owned(
-                np.asarray(out["forces"]), len(c))
+            e, f, _ = run_potential(
+                MODEL.energy_fn,
+                jax.tree.map(lambda x: jax.numpy.asarray(x, jax.numpy.float64), params),
+                c, lattice, species, CFG.cutoff, 1, compute_stress=False,
+                dtype=np.float64,
+            )
+            return e, f
 
         _, forces = energy(cart)
         h = 1e-5

@@ -1,11 +1,20 @@
-"""Shared helpers for model tests: build systems, run potentials."""
+"""Shared helpers for model tests: build systems, run potentials.
+
+``run_potential`` memoizes the jitted potential per (model, nparts,
+compute_stress) and shares one sticky CapacityPolicy across calls, so
+repeated evaluations of the same system (finite-difference loops,
+cutoff-smoothness scans, rotated copies) hit XLA's jit cache instead of
+recompiling — this is what keeps the suite wall time bounded.
+"""
+
+import weakref
 
 import numpy as np
 
 from distmlip_tpu import geometry
 from distmlip_tpu.neighbors import neighbor_list_numpy
 from distmlip_tpu.parallel import graph_mesh, make_potential_fn
-from distmlip_tpu.partition import build_plan, build_partitioned_graph
+from distmlip_tpu.partition import CapacityPolicy, build_plan, build_partitioned_graph
 
 
 def make_crystal(rng, reps=(4, 4, 4), a=4.0, noise=0.05, n_species=2):
@@ -17,16 +26,39 @@ def make_crystal(rng, reps=(4, 4, 4), a=4.0, noise=0.05, n_species=2):
     return cart, lattice, species
 
 
+_SHARED_CAPS = CapacityPolicy()
+# model -> {(nparts, compute_stress): jitted potential}; weak keys so
+# function-scoped models don't pin memory or alias recycled ids
+_POT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _potential_for(energy_fn, nparts, compute_stress):
+    owner = getattr(energy_fn, "__self__", None)
+    if owner is None:
+        mesh = graph_mesh(nparts) if nparts > 1 else None
+        return make_potential_fn(energy_fn, mesh, compute_stress=compute_stress)
+    per_owner = _POT_CACHE.setdefault(owner, {})
+    key = (nparts, bool(compute_stress))
+    if key not in per_owner:
+        mesh = graph_mesh(nparts) if nparts > 1 else None
+        per_owner[key] = make_potential_fn(
+            energy_fn, mesh, compute_stress=compute_stress
+        )
+    return per_owner[key]
+
+
 def run_potential(
     energy_fn, params, cart, lattice, species, r, nparts,
     bond_r=0.0, use_bond_graph=False, caps=None, compute_stress=True,
+    dtype=np.float32,
 ):
     """Full pipeline: neighbors -> partition -> graph -> potential."""
     nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], r, bond_r=bond_r)
     plan = build_plan(nl, lattice, [1, 1, 1], nparts, r, bond_r, use_bond_graph)
-    graph, host = build_partitioned_graph(plan, nl, species, lattice, caps=caps)
-    mesh = graph_mesh(nparts) if nparts > 1 else None
-    pot = make_potential_fn(energy_fn, mesh, compute_stress=compute_stress)
+    graph, host = build_partitioned_graph(
+        plan, nl, species, lattice, caps=caps or _SHARED_CAPS, dtype=dtype
+    )
+    pot = _potential_for(energy_fn, nparts, compute_stress)
     out = pot(params, graph, graph.positions)
     forces = host.gather_owned(np.asarray(out["forces"]), len(cart))
     return float(out["energy"]), forces, np.asarray(out["stress"])

@@ -1,0 +1,62 @@
+"""Scale-ladder runs (BASELINE.md progression configs).
+
+Config 2: TensorNet, ~50k-atom electrolyte-like supercell, 4-way graph
+partition. On a machine without 4 real chips this runs on a virtual
+8-device CPU mesh (slow but exact). Round-2 result (2026-07-29, CPU mesh):
+48,668 atoms — 4-way == 1-way to 2.5e-9 eV/atom, dF_max 9.9e-8 eV/Å.
+
+Run: python examples/05_scale_ladder.py [--config 2]
+"""
+
+import os
+
+import jax
+
+# default: virtual CPU mesh (set DISTMLIP_REAL_DEVICES=1 to use real chips;
+# probing jax.devices() first would initialize the backend and pin us to it)
+if not os.environ.get("DISTMLIP_REAL_DEVICES"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import time
+
+import numpy as np
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import Atoms, DistPotential
+from distmlip_tpu.models import TensorNet, TensorNetConfig
+
+
+def config2():
+    cfg = TensorNetConfig(num_species=16, units=64, num_rbf=8, num_layers=2,
+                          cutoff=5.0)
+    model = TensorNet(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 4.5, (23, 23, 23))
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, 0.05, (len(frac), 3)
+    )
+    atoms = Atoms(numbers=rng.integers(1, 17, len(cart)), positions=cart,
+                  cell=lattice)
+    smap = np.concatenate([[0], np.arange(0, 16)]).astype(np.int32)
+    print(f"config 2: TensorNet, n_atoms = {len(atoms)}")
+
+    results = {}
+    for P in (4, 1):
+        t0 = time.time()
+        pot = DistPotential(model, params, num_partitions=P, species_map=smap)
+        results[P] = pot.calculate(atoms)
+        print(f"{P}-way: E={results[P]['energy']:.4f} "
+              f"({time.time() - t0:.0f}s incl compile)")
+    de = abs(results[4]["energy"] - results[1]["energy"]) / len(atoms)
+    df = np.abs(results[4]["forces"] - results[1]["forces"]).max()
+    print(f"4-way vs 1-way: dE/atom={de:.2e} eV  dF_max={df:.2e} eV/Å")
+    assert de < 1e-6 and df < 5e-4
+    print("CONFIG 2 PASSED")
+
+
+if __name__ == "__main__":
+    config2()

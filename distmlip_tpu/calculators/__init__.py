@@ -3,12 +3,13 @@ from .elements import MASSES, SYMBOLS, symbols_to_numbers
 from .calculator import (DistPotential, EnsemblePotential, UMAPredictor,
                          make_ase_calculator)
 from .md import MolecularDynamics, TrajectoryObserver, ENSEMBLES
+from .device_md import DeviceMD
 from .relax import Relaxer, RelaxResult
 
 __all__ = [
     "Atoms", "KB", "AMU_A2_FS2_TO_EV", "EV_A3_TO_GPA",
     "MASSES", "SYMBOLS", "symbols_to_numbers",
     "DistPotential", "EnsemblePotential", "UMAPredictor", "make_ase_calculator",
-    "MolecularDynamics", "TrajectoryObserver", "ENSEMBLES",
+    "MolecularDynamics", "TrajectoryObserver", "ENSEMBLES", "DeviceMD",
     "Relaxer", "RelaxResult",
 ]

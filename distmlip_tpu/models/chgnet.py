@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import radial
-from ..ops.nn import (embedding, embedding_init, gated_mlp, gated_mlp_init,
+from ..ops.nn import (cast_params_subtrees, embedding, embedding_init, gated_mlp, gated_mlp_init,
                       layernorm, layernorm_init, linear, linear_init, mlp,
                       mlp_init)
 from ..ops.segment import masked_segment_sum
@@ -97,15 +97,25 @@ class CHGNet:
         v = self._trunk_features(params, lg, positions)
         return jnp.abs(mlp(params["magmom"], v)[:, 0])
 
+    supports_compute_dtype = True  # _trunk_features honors cfg.dtype
+
     def _trunk_features(self, params, lg, positions):
         cfg = self.cfg
         C = cfg.units
+        # features/GEMMs in the compute dtype; geometry and the readout
+        # (applied by the callers on the returned scalars) stay fp32
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else positions.dtype
+        if cfg.dtype == "bfloat16":
+            # readout/magmom heads run in the CALLERS on the original
+            # (uncast) params; the trunk returns fp32 features, so the whole
+            # trunk param tree can go bf16
+            params = cast_params_subtrees(params, dtype)
 
         # --- geometry ---
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
-        env = radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask
-        rbf = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_rbf)
+        env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
+        rbf = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_rbf).astype(dtype)
 
         # --- feature init ---
         v = embedding(params["atom_emb"], lg.species)          # (N, C)
@@ -133,9 +143,12 @@ class CHGNet:
             cos_t = jnp.clip(cos_t, -1.0 + 1e-6, 1.0 - 1e-6)
             theta = jnp.arccos(cos_t)
             a = linear(
-                params["angle_basis"], radial.fourier_expansion(theta, cfg.num_angle)
+                params["angle_basis"],
+                radial.fourier_expansion(theta, cfg.num_angle).astype(dtype),
             )                                                  # (L, C)
-            line_w = (b_env[lg.line_src] * b_env[lg.line_dst] * lg.line_mask)
+            line_w = (
+                b_env[lg.line_src] * b_env[lg.line_dst] * lg.line_mask
+            ).astype(dtype)
 
         # --- blocks ---
         for i, blk in enumerate(params["blocks"]):
@@ -150,7 +163,8 @@ class CHGNet:
                 # rebuilt from the exchanged edge features next block
                 e = lg.bond_to_edge(b, e)
 
-        return v
+        # readout layernorm statistics need full precision
+        return v.astype(positions.dtype)
 
     # ---- layers ----
     def _atom_conv(self, blk, lg, v, e, env):

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import radial
-from ..ops.nn import linear, linear_init, mlp, mlp_init
+from ..ops.nn import cast_params_subtrees, linear, linear_init, mlp, mlp_init
 from ..ops.segment import masked_segment_sum
 from ..ops.so3 import rotation_to_z, spherical_harmonics_stack, wigner_d_batch
 
@@ -155,25 +155,13 @@ class ESCN:
         # geometry and the final energy sum stay in the positions dtype
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else positions.dtype
         if cfg.dtype == "bfloat16":
-            # cast the GEMM-bearing subtrees only: species_ref (O(10-100) eV
-            # reference energies) and the energy readout stay fp32 so the
-            # energy path keeps full precision. The cast is O(param bytes)
-            # per step — negligible next to the edge activations.
-            keep_fp32 = ("species_ref", "energy_mlp")
-            params = {
-                k: (
-                    v
-                    if k in keep_fp32
-                    else jax.tree.map(
-                        lambda x: x.astype(dtype)
-                        if hasattr(x, "dtype")
-                        and jnp.issubdtype(x.dtype, jnp.floating)
-                        else x,
-                        v,
-                    )
-                )
-                for k, v in params.items()
-            }
+            # species_ref (O(10-100) eV reference energies) and the energy
+            # readout stay fp32 so the energy path keeps full precision. The
+            # cast is O(param bytes) per step — negligible next to the edge
+            # activations.
+            params = cast_params_subtrees(
+                params, dtype, keep_fp32=("species_ref", "energy_mlp")
+            )
 
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)

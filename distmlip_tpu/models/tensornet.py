@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import radial
-from ..ops.nn import (embedding, embedding_init, layernorm, layernorm_init,
+from ..ops.nn import (cast_params_subtrees, embedding, embedding_init, layernorm, layernorm_init,
                       linear, linear_init, mlp, mlp_init)
 from ..ops.segment import masked_segment_sum
 
@@ -121,17 +121,27 @@ class TensorNet:
             )
         return params
 
+    supports_compute_dtype = True  # energy_fn honors cfg.dtype="bfloat16"
+
     # ---- forward ----
     def energy_fn(self, params, lg, positions):
         cfg = self.cfg
         C = cfg.units
+        # features/GEMMs in the compute dtype; geometry + energy sum in the
+        # positions dtype (same policy as MACE/eSCN)
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else positions.dtype
+        if cfg.dtype == "bfloat16":
+            params = cast_params_subtrees(
+                params, dtype, keep_fp32=("species_ref", "readout", "readout_ln")
+            )
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
         rhat = vec / jnp.maximum(d, 1e-9)[:, None]
-        env = radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask
-        rbf = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_rbf)
+        env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
+        rbf = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_rbf).astype(dtype)
 
-        eye = jnp.eye(3, dtype=positions.dtype)
+        eye = jnp.eye(3, dtype=dtype)
+        rhat = rhat.astype(dtype)
         A_e = _vector_to_skew(rhat)                       # (E, 3, 3)
         S_e = rhat[:, :, None] * rhat[:, None, :] - eye / 3.0
 
@@ -158,8 +168,10 @@ class TensorNet:
         Xr, nr = tensor_rms_norm(X)
         I, A, S = decompose(Xr)
         inv = jnp.concatenate([tensor_norm(I), tensor_norm(A), tensor_norm(S)], axis=-1)
+        # readout in the positions dtype (fp32 energy accumulation)
+        inv = inv.astype(positions.dtype)
         e_atom = mlp(params["readout"], layernorm(params["readout_ln"], inv))[:, 0]
-        e_atom = e_atom * magnitude_gate(nr)[..., 0]
+        e_atom = e_atom * magnitude_gate(nr)[..., 0].astype(positions.dtype)
         e_ref = params["species_ref"]["w"][lg.species, 0]
         return e_atom + e_ref
 

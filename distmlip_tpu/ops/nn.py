@@ -45,6 +45,22 @@ def linear_init_vp(key, d_in: int, d_out: int):
     return {"w": jax.random.normal(key, (d_in, d_out)) / np.sqrt(d_in)}
 
 
+def cast_params_subtrees(params: dict, dtype, keep_fp32: tuple = ()):
+    """Cast floating leaves of a param dict to ``dtype``, leaving the named
+    top-level subtrees untouched (precision-critical pieces like species
+    reference energies and readout heads). Shared by the model zoo's
+    bfloat16 compute switch."""
+    def cast(tree):
+        return jax.tree.map(
+            lambda x: x.astype(dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+    return {k: (v if k in keep_fp32 else cast(v)) for k, v in params.items()}
+
+
 def silu_2mom_gain() -> float:
     """e3nn's normalize2mom(silu) constant: 1 / sqrt(E[silu(x)^2]), x~N(0,1),
     by Gauss-Hermite quadrature. Single source of truth shared by the

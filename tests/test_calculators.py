@@ -365,3 +365,36 @@ def test_device_md_thermostat_and_rebuild(rng):
     assert dmd.steps_done == 60
     assert dmd.rebuilds >= 1
     assert atoms.temperature() < 650.0
+
+
+@pytest.mark.parametrize("family", ["tensornet", "chgnet"])
+def test_bfloat16_switch_tensornet_chgnet(rng, family):
+    """bf16 one-call switch for the matgl-family models: runs end to end
+    with bounded deviation from fp32."""
+    import jax
+
+    from distmlip_tpu.calculators import Atoms, DistPotential
+    from distmlip_tpu.models import (CHGNet, CHGNetConfig, TensorNet,
+                                     TensorNetConfig)
+    from tests.utils import make_crystal
+
+    if family == "tensornet":
+        model = TensorNet(TensorNetConfig(num_species=8, units=16, num_rbf=6,
+                                          num_layers=2, cutoff=3.4))
+    else:
+        model = CHGNet(CHGNetConfig(num_species=8, units=16, num_rbf=6,
+                                    num_angle=4, num_blocks=2, cutoff=3.4,
+                                    bond_cutoff=2.8))
+    params = model.init(jax.random.PRNGKey(0))
+    cart, lattice, species = make_crystal(rng, reps=(3, 3, 3), n_species=8)
+    atoms = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+    smap = np.arange(0, 10, dtype=np.int32) - 1
+    r32 = DistPotential(model, params, num_partitions=1,
+                        species_map=smap).calculate(atoms)
+    r16 = DistPotential(model, params, num_partitions=1, species_map=smap,
+                        compute_dtype="bfloat16").calculate(atoms)
+    de = abs(r16["energy"] - r32["energy"]) / len(atoms)
+    f_scale = max(np.abs(r32["forces"]).max(), 1e-3)
+    df = np.abs(r16["forces"] - r32["forces"]).max() / f_scale
+    assert de < 1e-2, de
+    assert df < 0.15, df

@@ -293,19 +293,24 @@ def test_compute_dtype_guards(rng):
     import pytest as _pytest
 
     from distmlip_tpu.calculators import DistPotential
-    from distmlip_tpu.models import TensorNet, TensorNetConfig
+    from distmlip_tpu.models import PairConfig, PairPotential, TensorNet, TensorNetConfig
 
+    # PairPotential has no compute-dtype support: must reject loudly
+    pair = PairPotential(PairConfig(cutoff=3.0))
+    with _pytest.raises(ValueError, match="compute"):
+        DistPotential(pair, pair.init(), num_partitions=1,
+                      compute_dtype="bfloat16")
     model = TensorNet(TensorNetConfig(num_species=4, units=8, num_rbf=4,
                                       num_layers=1))
     params = model.init(jax.random.PRNGKey(0))
-    with _pytest.raises(ValueError, match="compute"):
-        DistPotential(model, params, num_partitions=1,
-                      compute_dtype="bfloat16")
     # global switch is ignored (without error) for unsupported models...
     distmlip_tpu.set_compute_dtype("bfloat16")
     try:
-        DistPotential(model, params, num_partitions=1)
-        # ...and picked up by supporting ones
+        pot_pair = DistPotential(pair, pair.init(), num_partitions=1)
+        assert pot_pair.model is pair  # untouched: switch ignored
+        # ...and picked up by supporting ones (TensorNet included now)
+        pot_tn = DistPotential(model, params, num_partitions=1)
+        assert pot_tn.model.cfg.dtype == "bfloat16"
         from distmlip_tpu.models import MACE, MACEConfig
 
         m = MACE(MACEConfig(num_species=4, channels=8, l_max=1, a_lmax=1,

@@ -23,12 +23,14 @@ from .atoms import AMU_A2_FS2_TO_EV, KB, Atoms
 
 
 def _make_chunk_stepper(total_energy, dt: float, skin: float):
-    """Jitted (params, graph, pos, vel, masses, n_steps, taut, t0) ->
+    """Jitted (params, graph, pos, ref, vel, masses, n_steps, taut, t0) ->
     (pos, vel, forces, steps_done, energy, kinetic): up to n_steps
     velocity-Verlet steps on device. A step whose trial positions would
-    leave the skin/2 validity radius of the reused neighbor list is NOT
-    committed (no force evaluation with a stale list ever reaches the
-    returned state) — the loop stops and the host rebuilds."""
+    leave the skin/2 validity radius of the reused neighbor list — measured
+    against ``ref``, the positions the graph was BUILT at, not chunk start,
+    so a warm cache can't double-spend the drift budget — is NOT committed
+    (no force evaluation with a stale list ever reaches the returned
+    state); the loop stops and the host rebuilds."""
     import jax
     import jax.numpy as jnp
 
@@ -39,7 +41,7 @@ def _make_chunk_stepper(total_energy, dt: float, skin: float):
         return e, -g
 
     @jax.jit
-    def run_chunk(params, graph, pos, vel, masses, n_steps, taut, t0):
+    def run_chunk(params, graph, pos, ref, vel, masses, n_steps, taut, t0):
         dtype = pos.dtype
         owned = graph.owned_mask[..., None].astype(dtype)
         inv_m = owned / (masses[..., None] * AMU_A2_FS2_TO_EV)
@@ -64,7 +66,7 @@ def _make_chunk_stepper(total_energy, dt: float, skin: float):
             pos_c, vel_c, f_c, steps, e_c, _ = state
             vel_h = vel_c + (0.5 * dt) * f_c * inv_m
             pos_n = pos_c + dt * vel_h * owned
-            disp = (pos_n - pos) * owned
+            disp = (pos_n - ref) * owned
             exceed = jnp.max(jnp.sum(disp * disp, axis=-1)) >= half
 
             def commit(_):
@@ -150,9 +152,17 @@ class DeviceMD:
             return
         max_chunk = int(max_chunk or steps)
         while remaining > 0:
+            builds_before = pot.rebuild_count
             graph, host, positions = pot._prepare(atoms)
-            self.rebuilds += 1
+            fresh = pot.rebuild_count != builds_before
+            self.rebuilds += int(fresh)
             dtype = np.asarray(graph.lattice).dtype
+            # skin criterion reference = the positions the graph was BUILT
+            # at (cache slot 3); on a fresh build this equals the current
+            # positions, on a warm cache it charges drift already spent
+            ref = host.scatter_global(
+                pot._cache[3].astype(dtype), graph.n_cap
+            )
             vel = host.scatter_global(
                 atoms.velocities.astype(dtype), graph.n_cap
             )
@@ -161,14 +171,20 @@ class DeviceMD:
             )
             n = jnp.int32(min(remaining, max_chunk))
             pos_f, vel_f, f_f, done, e_f, ke = self._stepper(
-                pot.params, graph, positions, vel, masses, n,
+                pot.params, graph, positions, ref, vel, masses, n,
                 jnp.float32(self.taut),
                 jnp.float32(self.temperature or 0.0),
             )
             done = int(done)
             if done == 0:
-                # first step already violates the skin criterion — the
-                # criterion uses build-time positions, so this cannot recur
+                if not fresh:
+                    # warm cache arrived with most of the skin budget spent;
+                    # rebuild at the current positions and retry
+                    pot._cache = None
+                    continue
+                # fresh build: criterion reference == current positions, so
+                # a zero-step chunk means one dt exceeds skin/2 — retrying
+                # cannot help
                 raise RuntimeError(
                     "device MD chunk made no progress; increase skin"
                 )
@@ -178,8 +194,12 @@ class DeviceMD:
             atoms.velocities = host.gather_owned(
                 np.asarray(vel_f, dtype=np.float64), len(atoms)
             )
-            # invalidate the potential's cache: positions moved on device
-            pot._cache = None
+            if done < int(n):
+                # chunk stopped on the skin criterion: the cached graph's
+                # drift budget is exhausted — drop it so the next chunk
+                # (or the next pot.calculate) rebuilds instead of paying a
+                # null device dispatch to find out
+                pot._cache = None
             self.energies.append(float(e_f))
             self.steps_done += done
             remaining -= done

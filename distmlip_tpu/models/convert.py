@@ -494,9 +494,49 @@ def mace_mapping(params, sd, model=None):
                           lambda a: a.reshape(())))
         rules.append(Rule("pair_repulsion_fn.a_prefactor",
                           ("zbl", "a_prefactor"), lambda a: a.reshape(())))
-        for name in ("pair_repulsion_fn.c", "pair_repulsion_fn.covalent_radii",
-                     "pair_repulsion_fn.p"):
-            consume(name)
+        # our ZBL evaluator hard-codes the universal screening coefficients,
+        # the Cordero covalent-radii table, and ties the envelope power to
+        # cfg.cutoff_p (upstream ZBLBasis ties it to num_polynomial_cutoff) —
+        # a checkpoint trained with different constants would silently
+        # evaluate the wrong pair physics, so check instead of just consuming
+        from .pair import COVALENT_RADII, _ZBL_C
+
+        consume("pair_repulsion_fn.c",
+                expect("pair_repulsion_fn.c", _ZBL_C,
+                       "ZBL screening coefficients", atol=1e-6))
+        consume("pair_repulsion_fn.p",
+                expect("pair_repulsion_fn.p", float(cfg.cutoff_p),
+                       "ZBL envelope power p (tied to cutoff_p)")
+                if cfg is not None else None)
+
+        def check_radii(a):
+            got = np.ravel(np.asarray(a, dtype=np.float64))
+            ours = COVALENT_RADII
+            n = min(got.size, ours.size)
+            # index 0 is the unused placeholder (ase uses 0.2 for 'X', we
+            # use 0.0) — compare real elements only
+            close = np.isclose(got[1:n], ours[1:n], atol=2e-2)
+            if not close.all():
+                bad = int(np.argmax(~close)) + 1
+                raise ValueError(
+                    f"checkpoint covalent radii differ from the built-in "
+                    f"Cordero table (first mismatch at Z={bad}: "
+                    f"{got[bad]} vs {ours[bad]}); the ZBL cutoff would be "
+                    f"wrong for those species"
+                )
+            # species beyond the built-in table (Z > {ours.size-1}) cannot
+            # be validated AND the runtime radii lookup would clamp to the
+            # last entry — refuse rather than evaluate wrong pair physics
+            if cfg is not None and cfg.atomic_numbers is not None:
+                over = [z for z in cfg.atomic_numbers if z >= ours.size]
+                if over:
+                    raise ValueError(
+                        f"ZBL covalent-radii table covers Z<="
+                        f"{ours.size - 1}; model species {over} are outside "
+                        f"it — extend COVALENT_RADII in models/pair.py"
+                    )
+
+        consume("pair_repulsion_fn.covalent_radii", check_radii)
 
     # remaining bookkeeping entries: e3nn output masks, CG sign calibration
     seen = {r.torch_name for r in rules}

@@ -340,9 +340,13 @@ class MACE:
         # site/readout energies accumulate in the positions dtype: bf16 has
         # too few mantissa bits for per-atom energy sums
         e_site = params["species_ref"]["w"][head][z].astype(acc_dtype)
-        if cfg.zbl:
-            e_site = e_site + self._zbl_site(params, lg, d, acc_dtype)
+        # ZBL joins the *interaction* energies: upstream ScaleShiftMACE puts
+        # pair_node_energy into node_es_list and scale-shifts the sum
+        # (reference mace/models.py:131,174-175), so it must sit inside
+        # scale*(...)+shift, not alongside the unscaled E0 reference
         acc = jnp.zeros(positions.shape[0], dtype=acc_dtype)
+        if cfg.zbl:
+            acc = acc + self._zbl_site(params, lg, d, acc_dtype)
 
         for t, inter in enumerate(params["interactions"]):
             body = partial(self._interaction, lg=lg, Y=Y, bessel=bessel,
@@ -379,6 +383,7 @@ class MACE:
         e_edge = zbl_edge_energy(
             z_num[lg.edge_src], z_num[lg.edge_dst], d.astype(dtype),
             a_exp=params["zbl"]["a_exp"], a_prefactor=params["zbl"]["a_prefactor"],
+            p=cfg.cutoff_p,
         )
         e_edge = jnp.where(lg.edge_mask, e_edge, 0.0)
         return 0.5 * masked_segment_sum(

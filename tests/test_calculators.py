@@ -352,6 +352,38 @@ def test_device_md_matches_host_md(rng):
     assert np.isfinite(dmd.results["energy"])
 
 
+def test_device_md_warm_cache_drift_budget(rng):
+    """A skin cache warmed by calculate() at *drifted* positions must not
+    double-spend the drift budget: DeviceMD charges drift against the
+    graph-BUILD positions, so the trajectory matches a cold-start run."""
+    from distmlip_tpu.calculators import (Atoms, DeviceMD, DistPotential,
+                                          MolecularDynamics)
+    from distmlip_tpu.models import PairConfig, PairPotential
+
+    model = PairPotential(PairConfig(cutoff=3.0, kind="lj"))
+    params = {"eps": np.float32(0.05), "sigma": np.float32(2.0)}
+    atoms = make_atoms(rng, reps=(3, 3, 3), noise=0.03)
+    pot = DistPotential(model, params, num_partitions=2, skin=0.5)
+    # warm the cache, then drift atoms close to the skin/2 validity edge
+    # WITHOUT re-calculating (cache still "valid" but nearly spent)
+    pot.calculate(atoms)
+    atoms.positions = atoms.positions + 0.23 / np.sqrt(3)
+    atoms.set_maxwell_boltzmann_velocities(300.0,
+                                           rng=np.random.default_rng(9))
+    atoms_cold = atoms.copy()
+
+    dmd = DeviceMD(pot, atoms, timestep=1.0)
+    dmd.run(20)
+    assert dmd.steps_done == 20
+
+    pot_cold = DistPotential(model, params, num_partitions=2, skin=0.5)
+    hmd = MolecularDynamics(atoms_cold, pot_cold, ensemble="nve",
+                            timestep=1.0)
+    hmd.run(20)
+    np.testing.assert_allclose(atoms.positions, atoms_cold.positions,
+                               atol=2e-4)
+
+
 def test_device_md_thermostat_and_rebuild(rng):
     """Berendsen NVT on device pulls T toward the target; a small skin
     forces mid-run rebuilds and the step count still completes."""

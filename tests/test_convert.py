@@ -97,8 +97,14 @@ def synthetic_mace_state_dict(model, rng):
         sd["pair_repulsion_fn.a_exp"] = np.array(0.3)
         sd["pair_repulsion_fn.a_prefactor"] = np.array(0.4543)
         sd["pair_repulsion_fn.c"] = np.array([0.18175, 0.50986, 0.28022, 0.02817])
-        sd["pair_repulsion_fn.covalent_radii"] = np.zeros(119)
-        sd["pair_repulsion_fn.p"] = np.array(6.0)
+        # upstream stores the ase covalent-radii table (119 entries); the
+        # converter validates it against the built-in Cordero table
+        from distmlip_tpu.models.pair import COVALENT_RADII
+
+        radii = np.full(119, 0.2)
+        radii[: len(COVALENT_RADII)] = COVALENT_RADII
+        sd["pair_repulsion_fn.covalent_radii"] = radii
+        sd["pair_repulsion_fn.p"] = np.array(float(cfg.cutoff_p))
     return sd
 
 

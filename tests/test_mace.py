@@ -148,6 +148,19 @@ def test_zbl_pair_repulsion(rng):
                         jnp.asarray([r_max + 0.01]))
     assert float(v[0]) == 0.0
 
+    # aggregation parity: upstream ScaleShiftMACE scale-shifts the SUM of
+    # interaction and pair energies (mace/models.py:131,174-175), so the
+    # isolated ZBL contribution must scale linearly with `scale`
+    zbl_1 = zbl_at(0.8)
+    params2 = {**params, "scale": params["scale"] * 2.0}
+    cart = np.array([[5.0, 5.0, 5.0], [5.8, 5.0, 5.0]])
+    e_on2, _, _ = run_potential(model.energy_fn, params2, cart, lattice,
+                                species, cfg.cutoff, 1, compute_stress=False)
+    e_off2, _, _ = run_potential(model_nozbl.energy_fn, params2, cart,
+                                 lattice, species, cfg.cutoff, 1,
+                                 compute_stress=False)
+    np.testing.assert_allclose(e_on2 - e_off2, 2.0 * zbl_1, rtol=1e-5)
+
 
 def test_multihead_readout(rng):
     """Heads must be independent: changing head-1 params leaves head 0

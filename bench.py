@@ -29,7 +29,7 @@ def _claim_backend():
     """
     import time as _time
 
-    retries = int(os.environ.get("BENCH_RETRIES", "3"))
+    retries = max(1, int(os.environ.get("BENCH_RETRIES", "3")))
     backoff = float(os.environ.get("BENCH_RETRY_BACKOFF_S", "30"))
     last = None
     for attempt in range(retries):

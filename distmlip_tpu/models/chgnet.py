@@ -1,23 +1,39 @@
 """CHGNet: charge-informed message passing with bond and angle graphs.
 
-TPU-native implementation of the CHGNet architecture (Deng et al. 2023, as
-re-implemented on DGL by matgl) — the model family the reference distributes
-with the most intricate machinery (reference
-implementations/matgl/models/chgnet.py:21-453): per-layer it runs an
-atom-graph conv, seeds bond-node features from edge features
-(``edge_to_bond``), refreshes halo bond/atom features, runs the bond-graph
-(angle) conv, and writes bond features back (``bond_to_edge``) — the 2-phase
-split of reference chgnet_layers.py:16-119 falls out naturally here because
-the line graph only draws in-lines to locally-computed bond nodes.
+TPU-native implementation of the CHGNet architecture in **matgl's exact
+parameterization** (the reference distributes matgl's CHGNet via
+``from_existing`` __dict__ copy, reference
+implementations/matgl/models/chgnet.py:551-560), so pretrained matgl
+checkpoints convert weight-for-weight (``convert.MAPPINGS["chgnet"]``).
 
-Feature streams:
-  v (atoms, N_cap x C), e (edges, E_cap x C), b (bond nodes, B_cap x C),
-  a (angles = line-graph edges, L_cap x A).
+Structure mirrored from the reference wrapper's usage of the upstream
+modules (reference chgnet.py:116-197, 231-453 and chgnet_layers.py:16-119):
+
+  - learnable radial bessel bases for bonds (``bond_expansion``) and
+    threebody bonds (``threebody_bond_expansion``), learnable Fourier angle
+    basis (``angle_expansion``); matgl's polynomial-cutoff-on-expansion
+    quirk replicated (reference chgnet.py:119-124, 174-182)
+  - shared per-edge/per-bond rbf weight linears (``atom_bond_weights``,
+    ``bond_bond_weights``, ``threebody_bond_weights``, reference
+    chgnet.py:267-294)
+  - per block: atom-graph conv (gated-MLP messages [v_src|v_dst|e],
+    weighted, summed to dst, bias-free out linear, residual), then the
+    2-phase bond-graph conv (reference chgnet_layers.py:96-119): node phase
+    updates bond features from line-graph messages [b_src|b_dst|angle|
+    v_center] with per-bond rbf weights, edge phase updates angle features
+  - sitewise readout (magmoms) runs BEFORE the final atom conv; the final
+    MLP readout after it (reference chgnet.py:391-440)
+
+Distributed flow per layer (atom conv -> edge_to_bond -> bond+atom halo
+exchange -> line-graph node conv -> bond_to_edge -> bond halo -> angle
+phase) matches reference chgnet.py:296-368; the node/edge conv split of
+reference chgnet_layers.py:16-119 falls out naturally here because the
+line graph only draws in-lines to locally-computed bond nodes.
 
 Geometry for halo bond nodes (their endpoints may not be present locally)
 arrives by bond-halo exchange of (vec, dist), matching the reference's
 bond_transfer of bond_dist/bond_vec (chgnet.py:126-164). Angles use
-cos(theta) at the shared center atom: bond1 = (s->d), bond2 = (d->k),
+theta at the shared center atom: bond1 = (s->d), bond2 = (d->k),
 cos = -v1.v2/|v1||v2| (the reference's src_bond_sign=-1, chgnet.py:190).
 """
 
@@ -27,29 +43,54 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops import radial
-from ..ops.nn import (cast_params_subtrees, embedding, embedding_init, gated_mlp, gated_mlp_init,
-                      layernorm, layernorm_init, linear, linear_init, mlp,
-                      mlp_init)
+from ..ops.nn import (cast_params_subtrees, embedding, gated_mlp,
+                      gated_mlp_init, linear, linear_init, mlp, mlp_init)
 from ..ops.segment import masked_segment_sum
 
 
 @dataclass(frozen=True)
 class CHGNetConfig:
-    num_species: int = 95
-    units: int = 64
-    num_rbf: int = 9          # radial basis size (atom-graph bonds)
-    num_angle: int = 9        # Fourier angle basis size -> 2*max_f+1 features
+    """matgl CHGNet hyperparameters (names kept close to this framework's
+    conventions; the matgl equivalents are noted)."""
+
+    num_species: int = 95     # len(element_types)
+    units: int = 64           # dim_atom/bond/angle_embedding (matgl: all 64)
+    num_rbf: int = 9          # max_n — radial bessel basis size
+    num_angle: int = 4        # max_f — Fourier angle basis -> 2*max_f+1 feats
     num_blocks: int = 4
     cutoff: float = 5.0
-    bond_cutoff: float = 3.0  # threebody / bond-graph cutoff
+    bond_cutoff: float = 3.0  # threebody_cutoff
+    cutoff_exponent: int = 5
+    atom_conv_hidden: tuple | None = None    # default (units,)
+    bond_conv_hidden: tuple | None = None    # default (units,)
+    angle_update_hidden: tuple = ()          # matgl default: single layer
+    bond_update_hidden: tuple | None = None  # matgl default: no atom-graph
+    #                                          edge update (bonds evolve via
+    #                                          the bond-graph conv only)
+    shared_bond_weights: str | None = "both"  # None|"bond"|"threebody"|"both"
+    final_hidden: tuple | None = None        # default (units, units)
+    num_site_targets: int = 1                # sitewise_readout width (magmom)
     use_bond_graph: bool = True
     dtype: str = "float32"
 
     @property
     def angle_dim(self) -> int:
         return 2 * self.num_angle + 1
+
+    @property
+    def _atom_hidden(self):
+        return self.atom_conv_hidden if self.atom_conv_hidden is not None else (self.units,)
+
+    @property
+    def _bond_hidden(self):
+        return self.bond_conv_hidden if self.bond_conv_hidden is not None else (self.units,)
+
+    @property
+    def _final_hidden(self):
+        return self.final_hidden if self.final_hidden is not None else (self.units, self.units)
 
 
 class CHGNet:
@@ -60,81 +101,122 @@ class CHGNet:
     def init(self, key) -> dict:
         cfg = self.cfg
         C, R, A = cfg.units, cfg.num_rbf, cfg.angle_dim
-        ks = iter(jax.random.split(key, 8 + 8 * cfg.num_blocks))
+        ks = iter(jax.random.split(key, 16 + 8 * cfg.num_blocks))
         params = {
-            "atom_emb": embedding_init(next(ks), cfg.num_species, C),
-            "bond_basis": linear_init(next(ks), R, C),
-            "angle_basis": linear_init(next(ks), A, C),
-            "blocks": [],
-            "readout": mlp_init(next(ks), [C, C, 1]),
-            "readout_ln": layernorm_init(C),
-            "magmom": mlp_init(next(ks), [C, 1]),
+            # learnable basis frequencies (matgl learn_basis=True)
+            "freq_bond": jnp.pi * jnp.arange(1, R + 1, dtype=jnp.float32),
+            "freq_three": jnp.pi * jnp.arange(1, R + 1, dtype=jnp.float32),
+            "freq_angle": jnp.arange(0, cfg.num_angle + 1, dtype=jnp.float32),
+            "atom_emb": {"w": jax.random.normal(next(ks), (cfg.num_species, C))},
+            "bond_emb": mlp_init(next(ks), [R, C]),
+            "angle_emb": mlp_init(next(ks), [A, C]),
+            "atom_blocks": [],
+            "bond_blocks": [],
+            "sitewise": linear_init(next(ks), C, cfg.num_site_targets),
+            "final": mlp_init(next(ks), [C] + list(cfg._final_hidden) + [1]),
             "species_ref": {"w": jnp.zeros((cfg.num_species, 1))},
+            "data_std": jnp.ones(()),
         }
-        for i in range(cfg.num_blocks):
+        sw = cfg.shared_bond_weights
+        if sw in ("bond", "both"):
+            params["atom_bond_w"] = linear_init(next(ks), R, C, bias=False)
+            params["bond_bond_w"] = linear_init(next(ks), R, C, bias=False)
+        if sw in ("threebody", "both"):
+            params["three_bond_w"] = linear_init(next(ks), R, C, bias=False)
+        for _ in range(cfg.num_blocks):
             blk = {
-                "atom_conv": gated_mlp_init(next(ks), 3 * C, [C, C]),
-                "atom_ln": layernorm_init(C),
+                "node_update": gated_mlp_init(
+                    next(ks), 3 * C, list(cfg._atom_hidden) + [C]),
+                "node_out": linear_init(next(ks), C, C, bias=False),
             }
-            if cfg.use_bond_graph and i < cfg.num_blocks - 1:
-                blk["bond_conv"] = gated_mlp_init(next(ks), 4 * C, [C, C])
-                blk["bond_ln"] = layernorm_init(C)
-                blk["angle_update"] = gated_mlp_init(next(ks), 3 * C, [C, C])
-                blk["angle_proj"] = linear_init(next(ks), C, C)
-            params["blocks"].append(blk)
+            if cfg.bond_update_hidden is not None:
+                blk["edge_update"] = gated_mlp_init(
+                    next(ks), 3 * C, list(cfg.bond_update_hidden) + [C])
+                blk["edge_out"] = linear_init(next(ks), C, C, bias=False)
+            params["atom_blocks"].append(blk)
+        if cfg.use_bond_graph:
+            for _ in range(cfg.num_blocks - 1):
+                params["bond_blocks"].append({
+                    "node_update": gated_mlp_init(
+                        next(ks), 4 * C, list(cfg._bond_hidden) + [C]),
+                    "node_out": linear_init(next(ks), C, C, bias=False),
+                    "angle_update": gated_mlp_init(
+                        next(ks), 4 * C, list(cfg.angle_update_hidden) + [C]),
+                })
         return params
 
     # ---- forward ----
     def energy_fn(self, params, lg, positions):
-        v = self._trunk_features(params, lg, positions)
-        h = layernorm(params["readout_ln"], v)
-        e_atom = mlp(params["readout"], h)[:, 0]
+        v, _ = self._trunk(params, lg, positions)
+        e_atom = mlp(params["final"], v)[:, 0]
         e_ref = params["species_ref"]["w"][lg.species, 0]
-        return e_atom + e_ref
+        return params["data_std"] * e_atom + e_ref
 
     def magmom_fn(self, params, lg, positions):
         """Site-wise magnetic moments (absolute value), CHGNet's charge proxy."""
-        v = self._trunk_features(params, lg, positions)
-        return jnp.abs(mlp(params["magmom"], v)[:, 0])
+        _, site = self._trunk(params, lg, positions)
+        return jnp.abs(site[:, 0])
 
-    supports_compute_dtype = True  # _trunk_features honors cfg.dtype
+    supports_compute_dtype = True  # _trunk honors cfg.dtype
 
-    def _trunk_features(self, params, lg, positions):
+    def _expansion(self, d, freq, cutoff):
+        """matgl bond_expansion semantics: learnable bessel basis with the
+        polynomial cutoff applied elementwise to the *expansion values*
+        (reference chgnet.py:119-124 — matgl's own quirk, replicated for
+        checkpoint parity; numerically ~1 so the smooth vanishing at the
+        cutoff comes from the sin basis itself)."""
+        rbf = radial.radial_bessel(d, freq, cutoff)
+        env = radial.matgl_polynomial_cutoff(rbf, cutoff, self.cfg.cutoff_exponent)
+        return env * rbf
+
+    def _trunk(self, params, lg, positions):
+        """Returns (atom features after the LAST conv, sitewise readout taken
+        BEFORE it — matgl's ordering, reference chgnet.py:391-419)."""
         cfg = self.cfg
         C = cfg.units
-        # features/GEMMs in the compute dtype; geometry and the readout
-        # (applied by the callers on the returned scalars) stay fp32
+        # features/GEMMs in the compute dtype; geometry, basis frequencies
+        # and the readout heads stay fp32
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else positions.dtype
+        fp = params
         if cfg.dtype == "bfloat16":
-            # readout/magmom heads run in the CALLERS on the original
-            # (uncast) params; the trunk returns fp32 features, so the whole
-            # trunk param tree can go bf16
-            params = cast_params_subtrees(params, dtype)
+            params = cast_params_subtrees(
+                params, dtype,
+                keep_fp32=("freq_bond", "freq_three", "freq_angle",
+                           "sitewise", "final", "species_ref", "data_std"))
 
-        # --- geometry ---
+        # --- geometry + bases ---
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
-        env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
-        rbf = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_rbf).astype(dtype)
+        rbf = (self._expansion(d, fp["freq_bond"], cfg.cutoff)
+               * lg.edge_mask[:, None]).astype(dtype)
 
         # --- feature init ---
-        v = embedding(params["atom_emb"], lg.species)          # (N, C)
-        e = linear(params["bond_basis"], rbf) * env[:, None]   # (E, C)
+        v = embedding(params["atom_emb"], lg.species)     # (N, C)
+        e = mlp(params["bond_emb"], rbf)                  # (E, C)
         v = lg.halo_exchange(v)
 
-        use_bg = cfg.use_bond_graph and lg.has_bond_graph
+        # shared rbf message weights (reference chgnet.py:267-294)
+        abw = linear(params["atom_bond_w"], rbf) if "atom_bond_w" in params else None
+        bbw = linear(params["bond_bond_w"], rbf) if "bond_bond_w" in params else None
+
+        use_bg = cfg.use_bond_graph and lg.has_bond_graph and params["bond_blocks"]
         if use_bg:
             # bond-node geometry: seed owned from edges, exchange halo rows
-            bgeo = jnp.zeros((lg.b_cap + 0, 4), dtype=positions.dtype)
+            # (reference bond_transfer of bond_dist/bond_vec, chgnet.py:126-164)
+            bgeo = jnp.zeros((lg.b_cap, 4), dtype=positions.dtype)
             edge_geo = jnp.concatenate([vec, d[:, None]], axis=-1)
             bgeo = lg.edge_to_bond(edge_geo, bgeo)
             bgeo = lg.bond_halo_exchange(bgeo)
             b_vec, b_d = bgeo[:, :3], bgeo[:, 3]
-            b_env = radial.polynomial_cutoff(b_d, cfg.bond_cutoff) * (
-                b_d > 1e-6
-            )  # padded bond rows have d=0 -> env forced to 0
+            b_real = b_d > 1e-6  # padded bond rows have d=0
+            rbf3 = (self._expansion(
+                jnp.where(b_real, b_d, 1.0), fp["freq_three"], cfg.bond_cutoff)
+                * b_real[:, None]).astype(dtype)
+            tbw = (linear(params["three_bond_w"], rbf3)
+                   if "three_bond_w" in params else None)
 
-            # angle features on line-graph edges
+            # angle features on line-graph edges (theta at the center atom;
+            # reference src_bond_sign=-1 + compute_theta, chgnet.py:184-197)
             v1 = b_vec[lg.line_src]
             v2 = b_vec[lg.line_dst]
             d1 = jnp.maximum(b_d[lg.line_src], 1e-6)
@@ -142,60 +224,80 @@ class CHGNet:
             cos_t = -jnp.sum(v1 * v2, axis=-1) / (d1 * d2)
             cos_t = jnp.clip(cos_t, -1.0 + 1e-6, 1.0 - 1e-6)
             theta = jnp.arccos(cos_t)
-            a = linear(
-                params["angle_basis"],
-                radial.fourier_expansion(theta, cfg.num_angle).astype(dtype),
-            )                                                  # (L, C)
-            line_w = (
-                b_env[lg.line_src] * b_env[lg.line_dst] * lg.line_mask
-            ).astype(dtype)
+            a = mlp(params["angle_emb"],
+                    radial.matgl_fourier_expansion(
+                        theta, fp["freq_angle"]).astype(dtype))  # (L, C)
 
-        # --- blocks ---
-        for i, blk in enumerate(params["blocks"]):
-            v, e = self._atom_conv(blk, lg, v, e, env)
+            # bond-node features are (re-)seeded from edge features at the
+            # top of every block (reference dist_forward re-seeds the same
+            # way, :253-264, :315-321), so no separate init pass is needed
+            b = jnp.zeros((lg.b_cap, C), dtype=e.dtype)
+
+        # --- message-passing blocks (reference chgnet.py:296-389) ---
+        for i in range(cfg.num_blocks - 1):
+            v, e = self._atom_conv(params["atom_blocks"][i], lg, v, e, abw, bbw)
             v = lg.halo_exchange(v)
-            if use_bg and "bond_conv" in blk:
-                b = jnp.zeros((lg.b_cap, C), dtype=v.dtype)
+            if use_bg:
                 b = lg.edge_to_bond(e, b)
                 b = lg.bond_halo_exchange(b)
-                b, a = self._bond_conv(blk, lg, v, b, a, line_w)
-                # bond_to_edge reads owned bond rows only; halo rows are
-                # rebuilt from the exchanged edge features next block
+                blk = params["bond_blocks"][i]
+                b = self._bond_node_conv(blk, lg, v, b, a, tbw)
                 e = lg.bond_to_edge(b, e)
+                b = lg.bond_halo_exchange(b)
+                a = self._angle_conv(blk, lg, v, b, a)
 
-        # readout layernorm statistics need full precision
-        return v.astype(positions.dtype)
+        # sitewise readout BEFORE the last atom conv (reference :391-398)
+        site = linear(fp["sitewise"], v.astype(positions.dtype))
+
+        # final atom conv (reference :400-419)
+        v, e = self._atom_conv(params["atom_blocks"][-1], lg, v, e, abw, bbw)
+        v = lg.halo_exchange(v)
+        return v.astype(positions.dtype), site
 
     # ---- layers ----
-    def _atom_conv(self, blk, lg, v, e, env):
-        """Gated message passing on the atom graph (owner-computes on dst)."""
+    def _atom_conv(self, blk, lg, v, e, abw, bbw):
+        """matgl CHGNetGraphConv: optional gated edge update, then gated node
+        messages weighted per edge, summed to dst (owner-computes), bias-free
+        out linear, residual."""
+        if "edge_update" in blk:
+            feats = jnp.concatenate([v[lg.edge_src], v[lg.edge_dst], e], axis=-1)
+            m = linear(blk["edge_out"], gated_mlp(blk["edge_update"], feats))
+            if bbw is not None:
+                m = m * bbw
+            e = e + m * lg.edge_mask[:, None].astype(m.dtype)
         feats = jnp.concatenate([v[lg.edge_src], v[lg.edge_dst], e], axis=-1)
-        m = gated_mlp(blk["atom_conv"], feats) * env[:, None]
+        m = gated_mlp(blk["node_update"], feats)
+        if abw is not None:
+            m = m * abw
         agg = masked_segment_sum(m, lg.edge_dst, lg.n_cap, lg.edge_mask,
                                  indices_are_sorted=True)
-        v = v + layernorm(blk["atom_ln"], agg)
+        v = v + linear(blk["node_out"], agg)
         return v, e
 
-    def _bond_conv(self, blk, lg, v, b, a, line_w):
-        """Angle-mediated bond update on the line graph.
-
-        Line edge (b1 -> b2) with center atom c updates bond b2 from
-        [b1, b2, angle, v_c]; only locally-computed bond nodes receive
-        in-lines (the partitioner's needs_in_line rule), halo bonds are
-        refreshed by the surrounding exchanges.
-        """
+    def _bond_node_conv(self, blk, lg, v, b, a, tbw):
+        """Line-graph node phase (matgl CHGNetLineGraphConv node update,
+        reference chgnet_layers.py:101-105): messages [b_src|b_dst|angle|
+        v_center] summed to the dst bond, out linear, per-bond rbf weights
+        applied post-aggregation, residual. Only locally-computed bond nodes
+        receive in-lines (the partitioner's needs_in_line rule); halo bonds
+        are refreshed by the surrounding exchanges."""
         feats = jnp.concatenate(
             [b[lg.line_src], b[lg.line_dst], a, v[lg.line_center]], axis=-1
         )
-        m = gated_mlp(blk["bond_conv"], feats) * line_w[:, None]
+        m = gated_mlp(blk["node_update"], feats)
         agg = masked_segment_sum(m, lg.line_dst, lg.b_cap, lg.line_mask,
                                  indices_are_sorted=True)
-        b = b + layernorm(blk["bond_ln"], agg)
+        upd = linear(blk["node_out"], agg)
+        if tbw is not None:
+            upd = upd * tbw
+        return b + upd
 
-        # angle update from the refreshed bond features
-        feats_a = jnp.concatenate(
-            [b[lg.line_src] + b[lg.line_dst], a, v[lg.line_center]], axis=-1
+    def _angle_conv(self, blk, lg, v, b, a):
+        """Line-graph edge phase (angle update from the refreshed bond
+        features, reference chgnet_layers.py:109-118): gated update on
+        [b_src|b_dst|angle|v_center], residual, no weights."""
+        feats = jnp.concatenate(
+            [b[lg.line_src], b[lg.line_dst], a, v[lg.line_center]], axis=-1
         )
-        a = a + gated_mlp(blk["angle_update"], feats_a) * line_w[:, None]
-        a = linear(blk["angle_proj"], a)
-        return b, a
+        m = gated_mlp(blk["angle_update"], feats)
+        return a + m * lg.line_mask[:, None].astype(m.dtype)

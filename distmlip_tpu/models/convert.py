@@ -548,6 +548,185 @@ def mace_mapping(params, sd, model=None):
     return rules
 
 
+# ---------------------------------------------------------------------------
+# CHGNet (matgl) mapping
+# ---------------------------------------------------------------------------
+
+def _torch_mlp_rules(sd: dict, prefix: str, path: tuple,
+                     seq: str = "layers") -> list[Rule]:
+    """matgl ``MLP`` (nn.ModuleList/Sequential ``seq`` of Linears interleaved
+    with activation modules) -> this framework's layer list. Linear indices
+    are discovered from the state dict (activations carry no params), so
+    hidden-depth and activation placement never need guessing."""
+    import re
+
+    idxs = sorted({
+        int(m.group(1))
+        for k in sd
+        if (m := re.fullmatch(
+            re.escape(prefix) + r"\." + seq + r"\.(\d+)\.weight", k))
+    })
+    if not idxs:
+        raise KeyError(f"no Linear layers found under {prefix}.{seq}")
+    rules = []
+    for j, k in enumerate(idxs):
+        rules.append(Rule(f"{prefix}.{seq}.{k}.weight", path + (j, "w"),
+                          lambda a: a.T))
+        if f"{prefix}.{seq}.{k}.bias" in sd:
+            rules.append(Rule(f"{prefix}.{seq}.{k}.bias", path + (j, "b")))
+    return rules
+
+
+def _torch_gated_mlp_rules(sd: dict, prefix: str, path: tuple) -> list[Rule]:
+    """matgl ``GatedMLP`` (two nn.Sequentials: ``layers`` core w/ silu,
+    ``gates`` w/ sigmoid-last) -> {'core': [...], 'gate': [...]}."""
+    return (_torch_mlp_rules(sd, prefix, path + ("core",), seq="layers")
+            + _torch_mlp_rules(sd, prefix, path + ("gate",), seq="gates"))
+
+
+@register_mapping("chgnet")
+def chgnet_mapping(params, sd, model=None):
+    """matgl ``CHGNet.state_dict()`` -> CHGNet params (the reference wraps
+    these checkpoints via from_existing, chgnet.py:551-560; module inventory
+    pinned by enable_distributed_mode, chgnet.py:455-549).
+
+    Also accepts a matgl ``Potential.state_dict()`` dump (``model.``-prefixed
+    keys): ``element_refs.property_offset`` -> species_ref, ``data_std`` ->
+    data_std; a nonzero ``data_mean`` is refused (it is a per-structure
+    offset this per-atom parameterization cannot carry exactly).
+    """
+    C = np.shape(params["atom_emb"]["w"])[1]
+    S = np.shape(params["atom_emb"]["w"])[0]
+    p = "model." if any(k.startswith("model.") for k in sd) else ""
+    rules: list[Rule] = []
+
+    def expect_zero(name):
+        def check(a):
+            if not np.allclose(np.asarray(a, dtype=np.float64), 0.0, atol=1e-12):
+                raise ValueError(
+                    f"{name} = {np.ravel(a)} is nonzero: matgl applies it "
+                    f"once per structure, which this per-atom parameterization "
+                    f"cannot represent exactly — fold it into element_refs "
+                    f"upstream or re-reference the checkpoint"
+                )
+        return check
+
+    # learnable basis frequencies (matgl RadialBessel/FourierExpansion)
+    rules.append(Rule(p + "bond_expansion.frequencies", ("freq_bond",)))
+    if "freq_three" in params and p + "threebody_bond_expansion.frequencies" in sd:
+        rules.append(Rule(p + "threebody_bond_expansion.frequencies",
+                          ("freq_three",)))
+        rules.append(Rule(p + "angle_expansion.frequencies", ("freq_angle",)))
+
+    # embeddings: atom_embedding is nn.Embedding (weight used as-is); a
+    # one-hot single-layer MLP variant is folded into the same table
+    if p + "atom_embedding.weight" in sd:
+        rules.append(Rule(p + "atom_embedding.weight", ("atom_emb", "w")))
+    else:
+        def onehot_fold(a):
+            W = a.T  # (S, C)
+            b = sd.get(p + "atom_embedding.layers.0.bias")
+            if b is not None:
+                W = W + np.asarray(_t(b))[None, :]
+            return W
+        rules.append(Rule(p + "atom_embedding.layers.0.weight",
+                          ("atom_emb", "w"), onehot_fold))
+        if p + "atom_embedding.layers.0.bias" in sd:
+            rules.append(Rule(p + "atom_embedding.layers.0.bias", None))
+    rules += _torch_mlp_rules(sd, p + "bond_embedding", ("bond_emb",))
+    if "freq_angle" in params and any(
+            k.startswith(p + "angle_embedding.") for k in sd):
+        rules += _torch_mlp_rules(sd, p + "angle_embedding", ("angle_emb",))
+
+    # shared rbf message weights (bias-free linears)
+    for tname, ours in (("atom_bond_weights", "atom_bond_w"),
+                        ("bond_bond_weights", "bond_bond_w"),
+                        ("threebody_bond_weights", "three_bond_w")):
+        if p + f"{tname}.weight" in sd:
+            if ours in params:
+                rules.append(Rule(p + f"{tname}.weight", (ours, "w"),
+                                  lambda a: a.T))
+            else:
+                raise ValueError(
+                    f"checkpoint has {tname} but the model config disables it "
+                    f"(shared_bond_weights); rebuild with a matching config"
+                )
+
+    def conv_rules(tpre, bpath, blk):
+        out = _torch_gated_mlp_rules(
+            sd, tpre + "node_update_func", bpath + ("node_update",))
+        if tpre + "node_out_func.weight" in sd:
+            out.append(Rule(tpre + "node_out_func.weight",
+                            bpath + ("node_out", "w"), lambda a: a.T))
+        else:
+            # upstream variant without the out linear: identity
+            blk["node_out"]["w"] = np.eye(C, dtype=np.float32)
+        return out
+
+    # atom graph blocks
+    for i, blk in enumerate(params["atom_blocks"]):
+        tpre = p + f"atom_graph_layers.{i}.conv_layer."
+        rules += conv_rules(tpre, ("atom_blocks", i), blk)
+        has_eu = any(k.startswith(tpre + "edge_update_func.") for k in sd)
+        if has_eu != ("edge_update" in blk):
+            raise ValueError(
+                f"atom_graph_layers.{i} edge update presence mismatch "
+                f"(checkpoint {has_eu} vs config bond_update_hidden); "
+                f"rebuild with a matching config"
+            )
+        if has_eu:
+            rules += _torch_gated_mlp_rules(
+                sd, tpre + "edge_update_func", ("atom_blocks", i, "edge_update"))
+            if tpre + "edge_out_func.weight" in sd:
+                rules.append(Rule(tpre + "edge_out_func.weight",
+                                  ("atom_blocks", i, "edge_out", "w"),
+                                  lambda a: a.T))
+            else:
+                blk["edge_out"]["w"] = np.eye(C, dtype=np.float32)
+
+    # bond graph blocks (line-graph conv + angle update)
+    for i, blk in enumerate(params["bond_blocks"]):
+        tpre = p + f"bond_graph_layers.{i}.conv_layer."
+        rules += conv_rules(tpre, ("bond_blocks", i), blk)
+        if any(k.startswith(tpre + "edge_update_func.") for k in sd):
+            rules += _torch_gated_mlp_rules(
+                sd, tpre + "edge_update_func", ("bond_blocks", i, "angle_update"))
+        else:
+            # no angle update in the checkpoint: zero ours (residual no-op)
+            blk["angle_update"] = jax_zero_like(blk["angle_update"])
+
+    # readouts
+    if p + "sitewise_readout.weight" in sd:
+        rules += linear_rule(p + "sitewise_readout", ("sitewise",),
+                             bias=p + "sitewise_readout.bias" in sd)
+    if any(k.startswith(p + "final_layer.gates.") for k in sd):
+        raise ValueError(
+            "checkpoint final_layer is a GatedMLP (final_mlp_type='gated'); "
+            "only the MLP readout is supported — file an issue"
+        )
+    rules += _torch_mlp_rules(sd, p + "final_layer", ("final",))
+
+    # Potential-level extras (matgl Potential.state_dict dumps)
+    if p:
+        if "element_refs.property_offset" in sd:
+            rules.append(Rule(
+                "element_refs.property_offset", ("species_ref", "w"),
+                lambda a: np.reshape(a, (-1,))[:S].reshape(S, 1)))
+        if "data_std" in sd:
+            rules.append(Rule("data_std", ("data_std",),
+                              lambda a: np.reshape(a, ())))
+        if "data_mean" in sd:
+            rules.append(Rule("data_mean", None, expect_zero("data_mean")))
+    return rules
+
+
+def jax_zero_like(tree):
+    import jax
+
+    return jax.tree.map(lambda x: np.zeros_like(np.asarray(x)), tree) \
+        if tree is not None else None
+
+
 def from_torch(arch: str, state_dict: dict, params, strict: bool = True,
                model=None):
     """Map an upstream torch ``state_dict`` onto this framework's ``params``.

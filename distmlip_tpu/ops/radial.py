@@ -49,6 +49,60 @@ def fourier_expansion(x, max_f: int, interval: float = np.pi):
     return jnp.concatenate([const, jnp.cos(arg), jnp.sin(arg)], axis=-1)
 
 
+def radial_bessel(d, frequencies, cutoff: float):
+    """matgl ``RadialBesselFunction``: sqrt(2/rc) * sin(freq * d/rc) / d.
+
+    ``frequencies`` is a learnable (R,) vector (init n*pi — at which the basis
+    vanishes smoothly at the cutoff). Safe at d=0 (returns the freq/rc limit).
+    Used by the matgl-parity CHGNet/TensorNet paths; the fixed-frequency
+    variant above stays for MACE.
+    """
+    rc = jnp.asarray(cutoff, dtype=d.dtype)
+    f = frequencies.astype(d.dtype)
+    x = d[..., None]
+    small = x < 1e-8
+    safe_x = jnp.where(small, 1.0, x)
+    out = jnp.sqrt(2.0 / rc) * jnp.sin(f * safe_x / rc) / safe_x
+    limit = jnp.sqrt(2.0 / rc) * f / rc
+    return jnp.where(small, limit, out)
+
+
+def matgl_fourier_expansion(x, frequencies, interval: float = np.pi):
+    """matgl ``FourierExpansion``: interleaved [cos(0x), sin(1x), cos(1x),
+    sin(2x), cos(2x), ...] / interval, with learnable frequencies 0..max_f.
+
+    x: (...,) -> (..., 2*max_f + 1). CHGNet's angle basis over x = theta.
+    The layout and 1/interval scaling match matgl exactly so converted
+    ``angle_embedding`` weights see the features they were trained on.
+    """
+    f = frequencies.astype(x.dtype)
+    arg = x[..., None] * f * (np.pi / interval)
+    cos = jnp.cos(arg)                   # (..., max_f + 1)
+    sin = jnp.sin(arg[..., 1:])          # (..., max_f)
+    out = jnp.zeros(x.shape + (2 * (f.shape[0] - 1) + 1,), dtype=x.dtype)
+    out = out.at[..., 0::2].set(cos)
+    out = out.at[..., 1::2].set(sin)
+    return out / interval
+
+
+def matgl_polynomial_cutoff(r, cutoff: float, p: int = 5):
+    """matgl ``polynomial_cutoff``: the same envelope polynomial but with
+    matgl's exact boundary semantics — evaluated on the raw ratio (no lower
+    clamp) and hard-zeroed above the cutoff. matgl's CHGNet applies this
+    *elementwise to the bessel expansion values*, not to distances (the
+    reference wrapper replicates that call, reference
+    implementations/matgl/models/chgnet.py:119-124, 174-182), so parity
+    requires the unclamped form: expansion values can be negative.
+    """
+    x = r / cutoff
+    p = int(p)
+    c1 = -(p + 1.0) * (p + 2.0) / 2.0
+    c2 = p * (p + 2.0)
+    c3 = -p * (p + 1.0) / 2.0
+    poly = 1.0 + c1 * x**p + c2 * x ** (p + 1) + c3 * x ** (p + 2)
+    return jnp.where(r <= cutoff, poly, 0.0)
+
+
 def polynomial_cutoff(d, cutoff: float, p: int = 6):
     """MACE-style polynomial envelope: 1 at 0, C^2-smooth 0 at cutoff."""
     x = d / cutoff

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Probe the axon TPU backend until it comes up; append status lines to
+# /tmp/tpu_watch.log and write /tmp/tpu_up when a matmul succeeds.
+rm -f /tmp/tpu_up
+while true; do
+  ts=$(date +%H:%M:%S)
+  out=$(timeout 240 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256, 256), jnp.bfloat16)
+print('OK', d[0].platform, d[0].device_kind, float((x @ x).sum()))
+" 2>&1 | tail -1)
+  echo "$ts $out" >> /tmp/tpu_watch.log
+  if [[ "$out" == OK* ]]; then
+    echo "$ts $out" > /tmp/tpu_up
+    exit 0
+  fi
+  sleep 180
+done

@@ -56,6 +56,7 @@ class DistPotential:
         caps: CapacityPolicy | None = None,
         skin: float = 0.0,
         compute_dtype: str | None = None,
+        partition_grid: tuple | None = None,
     ):
         import jax
 
@@ -86,6 +87,17 @@ class DistPotential:
         self.model = model
         self.params = params
         devices = list(devices if devices is not None else jax.devices())
+        if partition_grid is not None:
+            pg = int(np.prod(partition_grid))
+            if num_partitions is not None and num_partitions != pg:
+                raise ValueError(
+                    f"partition_grid {tuple(partition_grid)} implies "
+                    f"{pg} partitions but num_partitions={num_partitions}"
+                )
+            num_partitions = pg
+        self.partition_grid = (
+            tuple(int(g) for g in partition_grid) if partition_grid else None
+        )
         self.num_partitions = num_partitions or len(devices)
         self.mesh = (
             graph_mesh(self.num_partitions, devices) if self.num_partitions > 1 else None
@@ -170,7 +182,7 @@ class DistPotential:
         )
         plan = build_plan(
             nl, atoms.cell, atoms.pbc, self.num_partitions, r_build,
-            b_build, self.use_bond_graph,
+            b_build, self.use_bond_graph, grid=self.partition_grid,
         )
         graph, host = build_partitioned_graph(
             plan, nl, self._species(atoms.numbers), atoms.cell, caps=self.caps,
@@ -313,11 +325,14 @@ class EnsemblePotential:
 
     Reference analogue: MACECalculator_Dist model ensembles with mean/var of
     energies/forces/stresses (reference implementations/mace/mace.py:133-161
-    — which evaluates members sequentially). Here, on a single partition the
-    members evaluate in ONE device program via jax.vmap over stacked
-    parameter pytrees (``stacked``); multi-partition ensembles fall back to
-    sequential members sharing a capacity policy. Results carry ensemble
-    mean, variance, and the per-member stack.
+    — which evaluates members sequentially). Here the members evaluate in
+    ONE device program via jax.vmap over stacked parameter pytrees
+    (``stacked``, the default) — including multi-partition ensembles, where
+    the vmap batches the whole shard_map'd graph-parallel program (one
+    launch, one set of collectives, every member's GEMMs batched on the
+    MXU). ``stacked=False`` falls back to sequential members sharing a
+    capacity policy. Results carry ensemble mean, variance, and the
+    per-member stack.
     """
 
     def __init__(self, model, params_list, stacked: bool | None = None, **kwargs):
@@ -326,8 +341,8 @@ class EnsemblePotential:
         kwargs.setdefault("caps", CapacityPolicy())
         base = DistPotential(model, params_list[0], **kwargs)
         if stacked is None:
-            stacked = base.num_partitions == 1
-        self.stacked = bool(stacked) and base.num_partitions == 1
+            stacked = True
+        self.stacked = bool(stacked)
         self.compute_stress = base.compute_stress
         if self.stacked:
             import jax

@@ -187,8 +187,16 @@ class CHGNet:
         # --- geometry + bases ---
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
+        # matgl's graph simply has no edges beyond the cutoff; our neighbor
+        # list may carry skin-shell edges (cutoff < d <= cutoff+skin) for MD
+        # reuse, and the learnable bessel basis does not vanish out there —
+        # so in-cutoff membership is enforced explicitly, both on the basis
+        # (-> shared weights, embeddings) and on the message masks below.
+        # At d = cutoff this matches matgl exactly (its basis is ~0 there
+        # for near-n*pi frequencies; the hard edge-set boundary is matgl's).
+        in_r = lg.edge_mask & (d <= cfg.cutoff)
         rbf = (self._expansion(d, fp["freq_bond"], cfg.cutoff)
-               * lg.edge_mask[:, None]).astype(dtype)
+               * in_r[:, None]).astype(dtype)
 
         # --- feature init ---
         v = embedding(params["atom_emb"], lg.species)     # (N, C)
@@ -208,12 +216,19 @@ class CHGNet:
             bgeo = lg.edge_to_bond(edge_geo, bgeo)
             bgeo = lg.bond_halo_exchange(bgeo)
             b_vec, b_d = bgeo[:, :3], bgeo[:, 3]
-            b_real = b_d > 1e-6  # padded bond rows have d=0
+            # padded bond rows have d=0; skin-shell bonds (d > bond_cutoff)
+            # are excluded like skin-shell edges above
+            b_real = (b_d > 1e-6) & (b_d <= cfg.bond_cutoff)
             rbf3 = (self._expansion(
-                jnp.where(b_real, b_d, 1.0), fp["freq_three"], cfg.bond_cutoff)
-                * b_real[:, None]).astype(dtype)
+                jnp.where(b_d > 1e-6, b_d, 1.0), fp["freq_three"],
+                cfg.bond_cutoff) * b_real[:, None]).astype(dtype)
             tbw = (linear(params["three_bond_w"], rbf3)
                    if "three_bond_w" in params else None)
+
+            # line edges are live only when BOTH bonds are real and within
+            # the threebody cutoff (matgl's line graph contains only such
+            # pairs; skin-shell bonds must contribute nothing)
+            line_ok = lg.line_mask & b_real[lg.line_src] & b_real[lg.line_dst]
 
             # angle features on line-graph edges (theta at the center atom;
             # reference src_bond_sign=-1 + compute_theta, chgnet.py:184-197)
@@ -235,46 +250,48 @@ class CHGNet:
 
         # --- message-passing blocks (reference chgnet.py:296-389) ---
         for i in range(cfg.num_blocks - 1):
-            v, e = self._atom_conv(params["atom_blocks"][i], lg, v, e, abw, bbw)
+            v, e = self._atom_conv(params["atom_blocks"][i], lg, v, e, abw,
+                                   bbw, in_r)
             v = lg.halo_exchange(v)
             if use_bg:
                 b = lg.edge_to_bond(e, b)
                 b = lg.bond_halo_exchange(b)
                 blk = params["bond_blocks"][i]
-                b = self._bond_node_conv(blk, lg, v, b, a, tbw)
+                b = self._bond_node_conv(blk, lg, v, b, a, tbw, line_ok)
                 e = lg.bond_to_edge(b, e)
                 b = lg.bond_halo_exchange(b)
-                a = self._angle_conv(blk, lg, v, b, a)
+                a = self._angle_conv(blk, lg, v, b, a, line_ok)
 
         # sitewise readout BEFORE the last atom conv (reference :391-398)
         site = linear(fp["sitewise"], v.astype(positions.dtype))
 
         # final atom conv (reference :400-419)
-        v, e = self._atom_conv(params["atom_blocks"][-1], lg, v, e, abw, bbw)
+        v, e = self._atom_conv(params["atom_blocks"][-1], lg, v, e, abw, bbw,
+                               in_r)
         v = lg.halo_exchange(v)
         return v.astype(positions.dtype), site
 
     # ---- layers ----
-    def _atom_conv(self, blk, lg, v, e, abw, bbw):
+    def _atom_conv(self, blk, lg, v, e, abw, bbw, in_r):
         """matgl CHGNetGraphConv: optional gated edge update, then gated node
         messages weighted per edge, summed to dst (owner-computes), bias-free
-        out linear, residual."""
+        out linear, residual. ``in_r`` masks padded AND skin-shell edges."""
         if "edge_update" in blk:
             feats = jnp.concatenate([v[lg.edge_src], v[lg.edge_dst], e], axis=-1)
             m = linear(blk["edge_out"], gated_mlp(blk["edge_update"], feats))
             if bbw is not None:
                 m = m * bbw
-            e = e + m * lg.edge_mask[:, None].astype(m.dtype)
+            e = e + m * in_r[:, None].astype(m.dtype)
         feats = jnp.concatenate([v[lg.edge_src], v[lg.edge_dst], e], axis=-1)
         m = gated_mlp(blk["node_update"], feats)
         if abw is not None:
             m = m * abw
-        agg = masked_segment_sum(m, lg.edge_dst, lg.n_cap, lg.edge_mask,
+        agg = masked_segment_sum(m, lg.edge_dst, lg.n_cap, in_r,
                                  indices_are_sorted=True)
         v = v + linear(blk["node_out"], agg)
         return v, e
 
-    def _bond_node_conv(self, blk, lg, v, b, a, tbw):
+    def _bond_node_conv(self, blk, lg, v, b, a, tbw, line_ok):
         """Line-graph node phase (matgl CHGNetLineGraphConv node update,
         reference chgnet_layers.py:101-105): messages [b_src|b_dst|angle|
         v_center] summed to the dst bond, out linear, per-bond rbf weights
@@ -285,14 +302,14 @@ class CHGNet:
             [b[lg.line_src], b[lg.line_dst], a, v[lg.line_center]], axis=-1
         )
         m = gated_mlp(blk["node_update"], feats)
-        agg = masked_segment_sum(m, lg.line_dst, lg.b_cap, lg.line_mask,
+        agg = masked_segment_sum(m, lg.line_dst, lg.b_cap, line_ok,
                                  indices_are_sorted=True)
         upd = linear(blk["node_out"], agg)
         if tbw is not None:
             upd = upd * tbw
         return b + upd
 
-    def _angle_conv(self, blk, lg, v, b, a):
+    def _angle_conv(self, blk, lg, v, b, a, line_ok):
         """Line-graph edge phase (angle update from the refreshed bond
         features, reference chgnet_layers.py:109-118): gated update on
         [b_src|b_dst|angle|v_center], residual, no weights."""
@@ -300,4 +317,4 @@ class CHGNet:
             [b[lg.line_src], b[lg.line_dst], a, v[lg.line_center]], axis=-1
         )
         m = gated_mlp(blk["angle_update"], feats)
-        return a + m * lg.line_mask[:, None].astype(m.dtype)
+        return a + m * line_ok[:, None].astype(m.dtype)

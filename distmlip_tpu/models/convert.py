@@ -659,8 +659,10 @@ def chgnet_mapping(params, sd, model=None):
             out.append(Rule(tpre + "node_out_func.weight",
                             bpath + ("node_out", "w"), lambda a: a.T))
         else:
-            # upstream variant without the out linear: identity
-            blk["node_out"]["w"] = np.eye(C, dtype=np.float32)
+            # upstream variant without the out linear: identity (match the
+            # leaf's dtype so float64 parity paths stay float64)
+            blk["node_out"]["w"] = np.eye(
+                C, dtype=np.asarray(blk["node_out"]["w"]).dtype)
         return out
 
     # atom graph blocks
@@ -682,7 +684,8 @@ def chgnet_mapping(params, sd, model=None):
                                   ("atom_blocks", i, "edge_out", "w"),
                                   lambda a: a.T))
             else:
-                blk["edge_out"]["w"] = np.eye(C, dtype=np.float32)
+                blk["edge_out"]["w"] = np.eye(
+                    C, dtype=np.asarray(blk["edge_out"]["w"]).dtype)
 
     # bond graph blocks (line-graph conv + angle update)
     for i, blk in enumerate(params["bond_blocks"]):
@@ -717,6 +720,101 @@ def chgnet_mapping(params, sd, model=None):
                               lambda a: np.reshape(a, ())))
         if "data_mean" in sd:
             rules.append(Rule("data_mean", None, expect_zero("data_mean")))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# TensorNet (matgl / torchmd-net port) mapping
+# ---------------------------------------------------------------------------
+
+def _ln_rules(prefix: str, path: tuple) -> list[Rule]:
+    """nn.LayerNorm -> {'g', 'b'}."""
+    return [Rule(f"{prefix}.weight", path + ("g",)),
+            Rule(f"{prefix}.bias", path + ("b",))]
+
+
+@register_mapping("tensornet")
+def tensornet_mapping(params, sd, model=None):
+    """matgl ``TensorNet.state_dict()`` -> TensorNet params (the reference
+    wraps these checkpoints via from_existing, tensornet.py:204-214; module
+    inventory pinned by enable_distributed_mode :179-197 and the readout by
+    dist_forward :131-159). Accepts matgl ``Potential.state_dict()`` dumps
+    the same way as the CHGNet mapping.
+    """
+    p = "model." if any(k.startswith("model.") for k in sd) else ""
+    S = np.shape(params["species_emb"]["w"])[0]
+    rules: list[Rule] = []
+    tpre = p + "tensor_embedding."
+
+    rules.append(Rule(tpre + "emb.weight", ("species_emb", "w")))
+    rules += linear_rule(tpre + "emb2", ("emb2",),
+                         bias=tpre + "emb2.bias" in sd)
+    for i in range(3):
+        pre = tpre + f"distance_proj{i + 1}"
+        rules += linear_rule(pre, ("dist_proj", i), bias=pre + ".bias" in sd)
+    for i in range(2):
+        pre = tpre + f"linears_scalar.{i}"
+        rules += linear_rule(pre, ("emb_lin_scalar", i),
+                             bias=pre + ".bias" in sd)
+    for i in range(3):
+        rules.append(Rule(tpre + f"linears_tensor.{i}.weight",
+                          ("emb_lin_tensor", i, "w"), lambda a: a.T))
+    rules += _ln_rules(tpre + "init_norm", ("init_norm",))
+
+    for t, _ in enumerate(params["layers"]):
+        lpre = p + f"layers.{t}."
+        for i in range(3):
+            pre = lpre + f"linears_scalar.{i}"
+            rules += linear_rule(pre, ("layers", t, "lin_scalar", i),
+                                 bias=pre + ".bias" in sd)
+        for i in range(6):
+            rules.append(Rule(lpre + f"linears_tensor.{i}.weight",
+                              ("layers", t, "lin_tensor", i, "w"),
+                              lambda a: a.T))
+
+    rules += _ln_rules(p + "out_norm", ("out_norm",))
+    rules += linear_rule(p + "linear", ("linear",),
+                         bias=p + "linear.bias" in sd)
+    rules += _torch_mlp_rules(sd, p + "final_layer.gated", ("final",))
+
+    # radial-basis buffers: this framework's basis is the fixed n*pi bessel
+    # set — a checkpoint with trained or non-bessel frequencies cannot be
+    # represented, so validate instead of silently consuming
+    cfg = model.cfg if model is not None else None
+    for key in list(sd):
+        tail = key[len(p):] if key.startswith(p) else key
+        if tail.startswith("bond_expansion."):
+            if "frequenc" in tail and cfg is not None:
+                def check_freq(a, _n=cfg.num_rbf):
+                    got = np.ravel(np.asarray(a, dtype=np.float64))
+                    want = np.pi * np.arange(1, _n + 1)
+                    if got.size != want.size or not np.allclose(
+                            got, want, atol=1e-4):
+                        raise ValueError(
+                            "checkpoint bond_expansion frequencies differ "
+                            "from the fixed n*pi bessel basis; trained "
+                            "frequencies are not representable"
+                        )
+                rules.append(Rule(key, None, check_freq))
+            else:
+                rules.append(Rule(key, None))
+
+    if p:
+        if "element_refs.property_offset" in sd:
+            rules.append(Rule(
+                "element_refs.property_offset", ("species_ref", "w"),
+                lambda a: np.reshape(a, (-1,))[:S].reshape(S, 1)))
+        if "data_std" in sd:
+            rules.append(Rule("data_std", ("data_std",),
+                              lambda a: np.reshape(a, ())))
+        if "data_mean" in sd:
+            def expect_zero(a):
+                if not np.allclose(np.asarray(a, np.float64), 0.0, atol=1e-12):
+                    raise ValueError(
+                        "nonzero data_mean is a per-structure offset this "
+                        "per-atom parameterization cannot represent exactly"
+                    )
+            rules.append(Rule("data_mean", None, expect_zero))
     return rules
 
 

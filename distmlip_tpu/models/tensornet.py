@@ -1,18 +1,21 @@
 """TensorNet: O(3)-equivariant message passing on rank-2 tensor features.
 
-A TPU-native implementation of the TensorNet architecture (Simeon & De
-Fabritiis 2023) as deployed for MLIPs by matgl, matching the capability the
-reference wraps in its distributed TensorNet path (reference
-implementations/matgl/models/tensornet.py:10-161: per-partition interaction
-layers with an atom-feature halo exchange after each, then an invariant
-readout). Here each node carries X_i in R^{C x 3 x 3}; messages scale the
-neighbor tensor's irreducible components by radial weights; the update is a
-matrix polynomial — all dense (C,3,3) einsums that map straight onto the MXU.
+TPU-native implementation of TensorNet (Simeon & De Fabritiis 2023) in
+**matgl's exact parameterization** (torchmd-net port), so pretrained matgl
+checkpoints convert weight-for-weight (``convert.MAPPINGS["tensornet"]``).
+The reference distributes matgl's TensorNet via ``from_existing`` __dict__
+copy (reference implementations/matgl/models/tensornet.py:204-214); its
+module inventory is pinned by enable_distributed_mode (:179-197) and the
+readout math by dist_forward (:131-159): tensor_embedding -> interaction
+layers (atom_transfer after each) -> decompose/tensor_norm invariants ->
+out_norm LayerNorm -> linear -> final_layer.gated MLP -> sum.
 
-Distributed contract: edges live with their dst owner, so every in-edge of an
-owned node is local; after each layer the updated tensors of border nodes are
-refreshed on neighbors via ``lg.halo_exchange`` (one call per layer — same
-cadence as the reference's ``atom_transfer``, tensornet.py:121-128).
+Per-node state X_i in R^{C x 3 x 3}. All ops are dense (C,3,3) einsums that
+map straight onto the MXU. Distributed contract: edges live with their dst
+owner, so every in-edge of an owned node is local; after the embedding and
+each interaction layer the updated tensors of border nodes are refreshed on
+neighbors via ``lg.halo_exchange`` (same cadence as the reference's
+``atom_transfer``, tensornet.py:121-128).
 """
 
 from __future__ import annotations
@@ -23,19 +26,24 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import radial
-from ..ops.nn import (cast_params_subtrees, embedding, embedding_init, layernorm, layernorm_init,
-                      linear, linear_init, mlp, mlp_init)
+from ..ops.nn import (cast_params_subtrees, embedding, layernorm,
+                      layernorm_init, linear, linear_init, mlp, mlp_init)
 from ..ops.segment import masked_segment_sum
 
 
 @dataclass(frozen=True)
 class TensorNetConfig:
     num_species: int = 95
-    units: int = 64
+    units: int = 64           # hidden_channels
     num_rbf: int = 32
     num_layers: int = 2
     cutoff: float = 5.0
+    final_hidden: tuple | None = None  # final_layer.gated dims, default (units, units)
     dtype: str = "float32"
+
+    @property
+    def _final_hidden(self):
+        return self.final_hidden if self.final_hidden is not None else (self.units, self.units)
 
 
 def decompose(X):
@@ -53,34 +61,9 @@ def tensor_norm(X):
     return jnp.sum(X * X, axis=(-2, -1))
 
 
-def tensor_rms_norm(X):
-    """Bounded-gain normalization: divide by (RMS of channel norms + 1).
-
-    Gain is <= 1 everywhere — vanishing features stay vanishing (no
-    1/sqrt(eps) amplification that would create spurious forces at the
-    cutoff), while O(1)+ features are normalized to O(1). Returns
-    (X_normalized, per-channel squared norms of X_normalized).
-    """
-    n = tensor_norm(X)
-    scale = 1.0 / (jnp.sqrt(jnp.mean(n, axis=-1, keepdims=True)) + 1.0)
-    Xn = X * scale[..., None, None]
-    return Xn, n * scale**2
-
-
-def magnitude_gate(n, c: float = 0.01):
-    """Smooth per-atom gate in [0,1): mean-norm / (mean-norm + c).
-
-    Multiplies LayerNorm-driven MLP outputs so they (and their position
-    gradients) vanish smoothly as an atom's features vanish — keeps the
-    isolated-atom / cutoff limit force-free instead of letting LayerNorm
-    amplify vanishing signals.
-    """
-    nbar = jnp.mean(n, axis=-1, keepdims=True)
-    return nbar / (nbar + c)
-
-
 def _vector_to_skew(v):
-    """(..., 3) -> (..., 3, 3) antisymmetric [v]_x."""
+    """(..., 3) -> (..., 3, 3) antisymmetric [v]_x (torchmd-net
+    vector_to_skewtensor convention)."""
     zero = jnp.zeros_like(v[..., 0])
     rows = [
         jnp.stack([zero, -v[..., 2], v[..., 1]], axis=-1),
@@ -90,6 +73,12 @@ def _vector_to_skew(v):
     return jnp.stack(rows, axis=-2)
 
 
+def _mix(lin, comp):
+    """torchmd-net channel mix: Linear over the channel axis of a
+    (..., C, 3, 3) component (permute -> nn.Linear -> permute)."""
+    return jnp.einsum("...cij,cd->...dij", comp, lin["w"])
+
+
 class TensorNet:
     def __init__(self, config: TensorNetConfig = TensorNetConfig()):
         self.cfg = config
@@ -97,28 +86,34 @@ class TensorNet:
     # ---- parameters ----
     def init(self, key) -> dict:
         cfg = self.cfg
-        ks = iter(jax.random.split(key, 16 + 8 * cfg.num_layers))
+        ks = iter(jax.random.split(key, 24 + 10 * cfg.num_layers))
         C, R = cfg.units, cfg.num_rbf
         params = {
-            "species_emb": embedding_init(next(ks), cfg.num_species, C),
-            "edge_embed": mlp_init(next(ks), [2 * C + R, C, 3 * C]),
-            "emb_norm_mlp": mlp_init(next(ks), [C, C, 3 * C]),
-            "emb_ln": layernorm_init(C),
+            # tensor_embedding.*
+            "species_emb": {"w": jax.random.normal(next(ks), (cfg.num_species, C))},
+            "emb2": linear_init(next(ks), 2 * C, C),
+            "dist_proj": [linear_init(next(ks), R, C) for _ in range(3)],
+            "emb_lin_scalar": [linear_init(next(ks), C, 2 * C),
+                               linear_init(next(ks), 2 * C, 3 * C)],
+            "emb_lin_tensor": [linear_init(next(ks), C, C, bias=False)
+                               for _ in range(3)],
+            "init_norm": layernorm_init(C),
             "layers": [],
-            "readout": mlp_init(next(ks), [3 * C, C, 1]),
-            "readout_ln": layernorm_init(3 * C),
+            # readout (reference dist_forward :131-151)
+            "out_norm": layernorm_init(3 * C),
+            "linear": linear_init(next(ks), 3 * C, C),
+            "final": mlp_init(next(ks), [C] + list(cfg._final_hidden) + [1]),
             "species_ref": {"w": jnp.zeros((cfg.num_species, 1))},
+            "data_std": jnp.ones(()),
         }
         for _ in range(cfg.num_layers):
-            params["layers"].append(
-                {
-                    "rbf_w": linear_init(next(ks), R, 3 * C),
-                    "norm_mlp": mlp_init(next(ks), [C, C, 3 * C]),
-                    "ln": layernorm_init(C),
-                    "mix_in": [linear_init(next(ks), C, C, bias=False) for _ in range(3)],
-                    "mix_out": [linear_init(next(ks), C, C, bias=False) for _ in range(3)],
-                }
-            )
+            params["layers"].append({
+                "lin_scalar": [linear_init(next(ks), R, C),
+                               linear_init(next(ks), C, 2 * C),
+                               linear_init(next(ks), 2 * C, 3 * C)],
+                "lin_tensor": [linear_init(next(ks), C, C, bias=False)
+                               for _ in range(6)],
+            })
         return params
 
     supports_compute_dtype = True  # energy_fn honors cfg.dtype="bfloat16"
@@ -127,36 +122,51 @@ class TensorNet:
     def energy_fn(self, params, lg, positions):
         cfg = self.cfg
         C = cfg.units
-        # features/GEMMs in the compute dtype; geometry + energy sum in the
-        # positions dtype (same policy as MACE/eSCN)
+        # features/GEMMs in the compute dtype; geometry + readout stack in
+        # the positions dtype (same policy as MACE/eSCN/CHGNet)
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else positions.dtype
+        fp = params
         if cfg.dtype == "bfloat16":
             params = cast_params_subtrees(
-                params, dtype, keep_fp32=("species_ref", "readout", "readout_ln")
-            )
+                params, dtype,
+                keep_fp32=("species_ref", "out_norm", "linear", "final",
+                           "data_std"))
+
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
-        rhat = vec / jnp.maximum(d, 1e-9)[:, None]
-        env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
+        rhat = (vec / jnp.maximum(d, 1e-9)[:, None]).astype(dtype)
+        env = (radial.cosine_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
         rbf = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_rbf).astype(dtype)
 
+        # --- tensor embedding (torchmd-net TensorEmbedding) ---
         eye = jnp.eye(3, dtype=dtype)
-        rhat = rhat.astype(dtype)
-        A_e = _vector_to_skew(rhat)                       # (E, 3, 3)
+        A_e = _vector_to_skew(rhat)                              # (E, 3, 3)
         S_e = rhat[:, :, None] * rhat[:, None, :] - eye / 3.0
 
-        # --- embedding: per-edge tensors weighted by species + radial ---
-        z = embedding(params["species_emb"], lg.species)  # (N, C)
-        ef = jnp.concatenate([z[lg.edge_src], z[lg.edge_dst], rbf], axis=-1)
-        w = mlp(params["edge_embed"], ef).reshape(-1, 3, C) * env[:, None, None]
-        comps = jnp.stack(
-            [jnp.broadcast_to(eye, A_e.shape), A_e, S_e], axis=1
-        )                                                 # (E, 3, 3, 3)
-        edge_X = jnp.einsum("ekc,ekij->ecij", w, comps)   # (E, C, 3, 3)
+        z = embedding(params["species_emb"], lg.species)         # (N, C)
+        Zij = linear(params["emb2"],
+                     jnp.concatenate([z[lg.edge_src], z[lg.edge_dst]], axis=-1))
+        W1 = linear(params["dist_proj"][0], rbf) * env[:, None]  # (E, C)
+        W2 = linear(params["dist_proj"][1], rbf) * env[:, None]
+        W3 = linear(params["dist_proj"][2], rbf) * env[:, None]
+        edge_X = Zij[:, :, None, None] * (
+            W1[:, :, None, None] * eye
+            + W2[:, :, None, None] * A_e[:, None]
+            + W3[:, :, None, None] * S_e[:, None]
+        )                                                        # (E, C, 3, 3)
         X = masked_segment_sum(edge_X, lg.edge_dst, lg.n_cap, lg.edge_mask,
                                indices_are_sorted=True)
 
-        X = self._normalize_mix(params["emb_norm_mlp"], X, params["emb_ln"])
+        norm = layernorm(params["init_norm"], tensor_norm(X))
+        for lin in params["emb_lin_scalar"]:
+            norm = jax.nn.silu(linear(lin, norm))
+        norm = norm.reshape(-1, C, 3)
+        I, A, S = decompose(X)
+        I = _mix(params["emb_lin_tensor"][0], I)
+        A = _mix(params["emb_lin_tensor"][1], A)
+        S = _mix(params["emb_lin_tensor"][2], S)
+        X = (I * norm[..., 0, None, None] + A * norm[..., 1, None, None]
+             + S * norm[..., 2, None, None])
         X = lg.halo_exchange(X)
 
         # --- interaction layers ---
@@ -164,66 +174,45 @@ class TensorNet:
             X = self._interaction(lp, lg, X, rbf, env)
             X = lg.halo_exchange(X)
 
-        # --- invariant readout ---
-        Xr, nr = tensor_rms_norm(X)
-        I, A, S = decompose(Xr)
-        inv = jnp.concatenate([tensor_norm(I), tensor_norm(A), tensor_norm(S)], axis=-1)
-        # readout in the positions dtype (fp32 energy accumulation)
-        inv = inv.astype(positions.dtype)
-        e_atom = mlp(params["readout"], layernorm(params["readout_ln"], inv))[:, 0]
-        e_atom = e_atom * magnitude_gate(nr)[..., 0].astype(positions.dtype)
-        e_ref = params["species_ref"]["w"][lg.species, 0]
-        return e_atom + e_ref
-
-    def _normalize_mix(self, norm_mlp, X, ln):
-        C = self.cfg.units
-        X, n = tensor_rms_norm(X)
-        s = mlp(norm_mlp, layernorm(ln, n)).reshape(n.shape[:-1] + (3, C))
-        s = s * magnitude_gate(n)[..., None]
+        # --- invariant readout (reference dist_forward :131-151) ---
         I, A, S = decompose(X)
-        return (
-            s[..., 0, :, None, None] * I
-            + s[..., 1, :, None, None] * A
-            + s[..., 2, :, None, None] * S
-        )
-
-    def _mix_channels(self, lins, X):
-        """Per-component channel-mixing linear maps (C -> C)."""
-        I, A, S = decompose(X)
-        out = []
-        for lin, comp in zip(lins, (I, A, S)):
-            # (..., C, 3, 3) channel mix: contract channel axis
-            out.append(jnp.einsum("...cij,cd->...dij", comp, lin["w"]))
-        return out[0] + out[1] + out[2]
+        inv = jnp.concatenate(
+            [tensor_norm(I), tensor_norm(A), tensor_norm(S)], axis=-1
+        ).astype(positions.dtype)
+        x = linear(fp["linear"], layernorm(fp["out_norm"], inv))
+        e_atom = mlp(fp["final"], x)[:, 0]
+        e_ref = fp["species_ref"]["w"][lg.species, 0]
+        return fp["data_std"] * e_atom + e_ref
 
     def _interaction(self, lp, lg, X, rbf, env):
+        """torchmd-net TensorNetInteraction (O(3) group): radial edge gates,
+        per-channel normalization X/(||X||+1), channel mixes, neighbor
+        message M, B = YM + MY, normalized remix, X + dX + dX^2."""
         C = self.cfg.units
-        # normalize + per-channel mix
-        Xn, _ = tensor_rms_norm(X)
-        Xm = self._mix_channels(lp["mix_in"], Xn)
+        f = rbf
+        for lin in lp["lin_scalar"]:
+            f = jax.nn.silu(linear(lin, f))
+        f = (f * env[:, None]).reshape(-1, C, 3)
 
-        # radial message weights per component/channel
-        f = linear(lp["rbf_w"], rbf).reshape(-1, 3, C) * env[:, None, None]
-        I_j, A_j, S_j = decompose(Xm[lg.edge_src])
-        M = (
-            f[:, 0, :, None, None] * I_j
-            + f[:, 1, :, None, None] * A_j
-            + f[:, 2, :, None, None] * S_j
-        )
-        Y = masked_segment_sum(M, lg.edge_dst, lg.n_cap, lg.edge_mask,
+        X = X / (tensor_norm(X) + 1.0)[..., None, None]
+        I, A, S = decompose(X)
+        I = _mix(lp["lin_tensor"][0], I)
+        A = _mix(lp["lin_tensor"][1], A)
+        S = _mix(lp["lin_tensor"][2], S)
+        Y = I + A + S
+
+        msg = (f[:, :, 0, None, None] * I[lg.edge_src]
+               + f[:, :, 1, None, None] * A[lg.edge_src]
+               + f[:, :, 2, None, None] * S[lg.edge_src])
+        M = masked_segment_sum(msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
                                indices_are_sorted=True)
 
-        # matrix-polynomial node update
-        Y2 = jnp.einsum("...ij,...jk->...ik", Y, Y)
-        B = Y + Y2
-        Bn, bn = tensor_rms_norm(B)
-        s = mlp(lp["norm_mlp"], layernorm(lp["ln"], bn)).reshape(bn.shape[:-1] + (3, C))
-        s = s * magnitude_gate(bn)[..., None]
-        I_b, A_b, S_b = decompose(Bn)
-        dX = (
-            s[..., 0, :, None, None] * I_b
-            + s[..., 1, :, None, None] * A_b
-            + s[..., 2, :, None, None] * S_b
-        )
-        dX = self._mix_channels(lp["mix_out"], dX)
-        return X + dX
+        B = jnp.einsum("...ij,...jk->...ik", Y, M) \
+            + jnp.einsum("...ij,...jk->...ik", M, Y)
+        I, A, S = decompose(B)
+        np1 = (tensor_norm(B) + 1.0)[..., None, None]
+        I = _mix(lp["lin_tensor"][3], I / np1)
+        A = _mix(lp["lin_tensor"][4], A / np1)
+        S = _mix(lp["lin_tensor"][5], S / np1)
+        dX = I + A + S
+        return X + dX + jnp.einsum("...ij,...jk->...ik", dX, dX)

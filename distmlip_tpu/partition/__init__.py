@@ -1,11 +1,12 @@
 from .plan import PartitionPlan
-from .partitioner import build_plan, PartitionError
+from .partitioner import build_block_plan, build_plan, PartitionError
 from .graph import PartitionedGraph, HostGraphData, build_partitioned_graph
 from .capacity import CapacityPolicy, round_capacity
 
 __all__ = [
     "PartitionPlan",
     "build_plan",
+    "build_block_plan",
     "PartitionError",
     "PartitionedGraph",
     "HostGraphData",

@@ -122,19 +122,37 @@ class HostGraphData:
         return out
 
 
-def _halo_tables(plan: PartitionPlan, section_fn, n_cap, caps, name):
-    """Build (S, P, H) send/recv tables for a node-layout with to/from sections."""
+def _halo_tables(plan: PartitionPlan, section_fn, n_cap, caps, name,
+                 send_lists=None, recv_lists=None):
+    """Build (S, P, H) send/recv tables.
+
+    Two sources: slab plans expose contiguous to/from layout sections
+    (``section_fn``); block plans expose explicit per-(p, q) local-index
+    lists (``send_lists``/``recv_lists``, see PartitionPlan) because their
+    send sets overlap — a border node goes to up to 7 peers in 3-D. Either
+    way the result is one gather->ppermute->scatter round per active ring
+    shift; both sides of a pair are ordered by global id so payload slot i
+    lands in recv slot i.
+    """
     P = plan.num_partitions
-    # which ring shifts are actually used
+    if send_lists is not None:
+        def pair(p, kind, q):
+            lists = send_lists if kind == "to" else recv_lists
+            return np.asarray(lists[p].get(q, np.zeros(0, np.int64)))
+    else:
+        def pair(p, kind, q):
+            s_, e_ = section_fn(p, kind, q)
+            return np.arange(s_, e_, dtype=np.int64)
+
     shift_counts: dict[int, int] = {}
     for p in range(P):
         for q in range(P):
             if q == p:
                 continue
-            s_, e_ = section_fn(p, "to", q)
-            if e_ > s_:
+            cnt = len(pair(p, "to", q))
+            if cnt:
                 shift = (q - p) % P
-                shift_counts[shift] = max(shift_counts.get(shift, 0), e_ - s_)
+                shift_counts[shift] = max(shift_counts.get(shift, 0), cnt)
     shifts = tuple(sorted(shift_counts))
     h_cap = caps.get(name, max(shift_counts.values(), default=0))
     S = max(len(shifts), 1)
@@ -144,16 +162,14 @@ def _halo_tables(plan: PartitionPlan, section_fn, n_cap, caps, name):
     for si, s in enumerate(shifts):
         for p in range(P):
             q = (p + s) % P
-            ts, te = section_fn(p, "to", q)
-            cnt = te - ts
-            if cnt > 0:
-                send_idx[si, p, :cnt] = np.arange(ts, te)
-                send_mask[si, p, :cnt] = True
+            to_idx = pair(p, "to", q)
+            if len(to_idx):
+                send_idx[si, p, : len(to_idx)] = to_idx
+                send_mask[si, p, : len(to_idx)] = True
             src_p = (p - s) % P
-            fs, fe = section_fn(p, "from", src_p)
-            rcnt = fe - fs
-            if rcnt > 0:
-                recv_idx[si, p, :rcnt] = np.arange(fs, fe)
+            fr_idx = pair(p, "from", src_p)
+            if len(fr_idx):
+                recv_idx[si, p, : len(fr_idx)] = fr_idx
     return shifts, send_idx, send_mask, recv_idx
 
 
@@ -215,7 +231,9 @@ def build_partitioned_graph(
         edge_mask[p, :ne] = True
         assert np.all(np.diff(edge_dst[p]) >= 0), "edge_dst must be sorted"
 
-    shifts, h_send, h_smask, h_recv = _halo_tables(plan, plan.section, n_cap, caps, "halo")
+    shifts, h_send, h_smask, h_recv = _halo_tables(
+        plan, plan.section, n_cap, caps, "halo",
+        send_lists=plan.halo_send, recv_lists=plan.halo_recv)
 
     if plan.has_bond_graph:
         b_cap = caps.get("bonds", max(int(m[-1]) for m in plan.bond_markers))
@@ -243,7 +261,8 @@ def build_partitioned_graph(
             bm_bond[p, :nm] = plan.bond_mapping_bond[p]
             bm_mask[p, :nm] = True
         b_shifts, b_send, b_smask, b_recv = _halo_tables(
-            plan, plan.bond_section, b_cap, caps, "bond_halo"
+            plan, plan.bond_section, b_cap, caps, "bond_halo",
+            send_lists=plan.bond_halo_send, recv_lists=plan.bond_halo_recv,
         )
         # the node and bond exchanges must ride the same ring shifts
         all_shifts = tuple(sorted(set(shifts) | set(b_shifts)))

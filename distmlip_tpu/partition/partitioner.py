@@ -112,13 +112,25 @@ def build_plan(
     bond_r: float = 0.0,
     use_bond_graph: bool = False,
     impl: str = "auto",
+    grid: tuple | None = None,
 ) -> PartitionPlan:
     """Partition a neighbor graph into ``num_partitions`` slabs with halos.
 
     impl: "auto" prefers the native C++/OpenMP partitioner and falls back to
     numpy; "native"/"numpy" force one implementation (tests compare the two
     for exact equality).
+
+    grid: optional (gx, gy, gz) block decomposition (prod == num_partitions)
+    — delegates to :func:`build_block_plan`, which drops the slab path's
+    one-destination border invariant.
     """
+    if grid is not None:
+        if int(np.prod(grid)) != int(num_partitions):
+            raise PartitionError(
+                f"grid {tuple(grid)} has {int(np.prod(grid))} blocks, "
+                f"expected num_partitions={num_partitions}"
+            )
+        return build_block_plan(nl, lattice, pbc, grid, r, bond_r, use_bond_graph)
     lattice = np.asarray(lattice, dtype=np.float64)
     n = nl.wrapped_cart.shape[0]
     P = int(num_partitions)
@@ -268,6 +280,282 @@ def _build_plan_native(nl, frac_axis, axis, walls, P, use_bond_graph) -> Partiti
     return plan
 
 
+def build_block_plan(
+    nl: NeighborList,
+    lattice: np.ndarray,
+    pbc,
+    grid,
+    r: float,
+    bond_r: float = 0.0,
+    use_bond_graph: bool = False,
+) -> PartitionPlan:
+    """2-D/3-D block decomposition with per-peer halo lists.
+
+    Generalizes the reference's 1-D slab rule (reference
+    subgraph_creation_utils.c:1370-1456) to a (gx, gy, gz) grid of blocks:
+    walls are placed independently per axis (same atom-plane nudging as the
+    slab path) and a node's owner is its block's flat index. The slab path's
+    "border node reaches exactly one peer" invariant is dropped — a corner
+    atom may be needed by up to 7 peers in 3-D — so halo membership is
+    derived EXACTLY from the edge list (partition of dst needs src), stored
+    as explicit per-(p, q) send/recv lists that the halo-table builder turns
+    into one ``ppermute`` per active ring shift. Because halos come from the
+    actual edges rather than slab geometry, correctness holds for any block
+    size; blocks thinner than the cutoff only cost more communication
+    (warned). Owner-computes edge assignment, the line-graph build and the
+    capacity-padded device layout are shared with the slab path.
+    """
+    lattice = np.asarray(lattice, dtype=np.float64)
+    grid = tuple(int(g) for g in grid)
+    if len(grid) != 3 or any(g < 1 for g in grid):
+        raise PartitionError(f"grid must be 3 positive ints, got {grid}")
+    P = int(np.prod(grid))
+    if P == 1:
+        return _single_partition_plan(nl, use_bond_graph)
+    n = nl.wrapped_cart.shape[0]
+    src, dst = nl.src, nl.dst
+    frac = geometry.cart_to_frac(nl.wrapped_cart, lattice)
+    spacings = geometry.plane_spacings(lattice)
+
+    # non-periodic axes are fine to cut: wrapped fracs stay in [0,1)
+    coords = np.zeros((3, n), dtype=np.int64)
+    for ax, g in enumerate(grid):
+        if g == 1:
+            continue
+        width = spacings[ax] / g
+        if width <= r:
+            import warnings
+
+            warnings.warn(
+                f"Block width {width:.3f} Å along axis {ax} <= cutoff "
+                f"{r:.3f} Å: halos span non-adjacent blocks (still correct — "
+                f"halo sets come from the edge list — but communication-"
+                f"heavy).",
+                stacklevel=2,
+            )
+        coords[ax] = which_partition(make_walls(frac[:, ax], g), frac[:, ax])
+    node_part = (coords[0] * grid[1] + coords[1]) * grid[2] + coords[2]
+
+    plan = PartitionPlan(
+        P, -1, np.zeros(0), node_part, np.full(n, -1, dtype=np.int64)
+    )
+    plan.grid = grid
+
+    # --- exact halo membership from the edge list: owner(dst) needs src ---
+    cross = node_part[src] != node_part[dst]
+    key = src[cross] * P + node_part[dst[cross]]
+    ukey = np.unique(key)
+    h_node = ukey // P       # global id of the needed node (sorted)
+    h_need = ukey % P        # partition that needs it
+    h_own = node_part[h_node]
+
+    plan.halo_send = [dict() for _ in range(P)]
+    plan.halo_recv = [dict() for _ in range(P)]
+
+    border = np.zeros(n, dtype=bool)
+    border[h_node] = True
+    for p in range(P):
+        owned = np.nonzero(node_part == p)[0]
+        pure = owned[~border[owned]]
+        brd = owned[border[owned]]
+        # halo nodes p needs, grouped by owner, sorted by global id
+        mine = h_node[h_need == p]
+        owners = h_own[h_need == p]
+        sections = [pure, brd]
+        counts = [len(pure), len(brd)] + [0] * (P - 1)
+        from_counts = []
+        for q in range(P):
+            from_q = mine[owners == q] if q != p else np.zeros(0, np.int64)
+            sections.append(from_q)
+            from_counts.append(len(from_q))
+        gids = np.concatenate(sections)
+        # markers: [0, pure, border-as-to_0, (empty to_q)..., from_*..., total]
+        # — block send sets overlap, so per-peer "to" sections don't exist;
+        # halo tables use plan.halo_send instead (see PartitionPlan docs)
+        markers = np.concatenate([[0], np.cumsum(counts + from_counts)]).astype(np.int64)
+        g2l = np.full(n, -1, dtype=np.int64)
+        g2l[gids] = np.arange(len(gids))
+        plan.global_ids.append(gids)
+        plan.node_markers.append(markers)
+        plan.g2l.append(g2l)
+    for p in range(P):
+        g2l = plan.g2l[p]
+        # send lists: owned nodes of p needed by q (sorted by gid on both ends)
+        out = h_node[h_own == p]
+        out_need = h_need[h_own == p]
+        for q in range(P):
+            u = out[out_need == q]
+            if len(u):
+                plan.halo_send[p][q] = g2l[u].astype(np.int64)
+        # recv slots: p's from_q sections, in the same sorted-gid order
+        m = plan.node_markers[p]
+        for q in range(P):
+            fs, fe = int(m[1 + P + q]), int(m[2 + P + q])
+            if fe > fs:
+                plan.halo_recv[p][q] = np.arange(fs, fe, dtype=np.int64)
+
+    # --- owner-computes edge assignment + localization (shared layout) ---
+    edge_part = node_part[dst]
+    for p in range(P):
+        eids = np.nonzero(edge_part == p)[0]
+        ls = plan.g2l[p][src[eids]]
+        ld = plan.g2l[p][dst[eids]]
+        if np.any(ls < 0) or np.any(ld < 0):
+            raise PartitionError("internal error: edge endpoint missing from partition")
+        plan.edge_ids.append(eids)
+        plan.src_local.append(ls)
+        plan.dst_local.append(ld)
+        plan.edge_offsets.append(nl.offsets[eids])
+
+    if use_bond_graph:
+        _build_block_bond_graph(plan, nl, h_node, h_need)
+    return plan
+
+
+def _build_block_bond_graph(plan, nl, h_node, h_need) -> None:
+    """Bond (line) graph for block plans.
+
+    Same semantics as the slab path (a bond node lives wherever its dst atom
+    is visible; owned where the dst atom is owned) but halo-bond membership
+    is derived from the atom halo pairs: bond (s->d) owned by p is needed by
+    q exactly when atom d is in q's halo.
+    """
+    P = plan.num_partitions
+    src, dst = nl.src, nl.dst
+    node_part = plan.node_part
+    W = np.nonzero(nl.bond_mask)[0]
+    if np.any(src[W] == dst[W]):
+        import warnings
+
+        warnings.warn(
+            "Found self-loop edge within bond cutoff (cell smaller than bond "
+            "graph cutoff); line-graph results may be incorrect.",
+            stacklevel=3,
+        )
+    plan.has_bond_graph = True
+    plan.bond_halo_send = [dict() for _ in range(P)]
+    plan.bond_halo_recv = [dict() for _ in range(P)]
+
+    wdst = dst[W]
+    # (bond, q) pairs: q needs bond iff q has atom dst in its halo
+    order = np.argsort(h_node, kind="stable")
+    hn_sorted, hq_sorted = h_node[order], h_need[order]
+    gs = np.searchsorted(hn_sorted, wdst, side="left")
+    ge = np.searchsorted(hn_sorted, wdst, side="right")
+    cnt = ge - gs
+    b_rep = np.repeat(np.arange(len(W)), cnt)          # index into W
+    total = int(cnt.sum())
+    csum = np.concatenate([[0], np.cumsum(cnt)])
+    intra = np.arange(total) - np.repeat(csum[:-1], cnt)
+    q_rep = hq_sorted[np.repeat(gs, cnt) + intra]      # needing partition
+
+    # border flag per W-bond (needed by at least one other partition)
+    b_border = np.zeros(len(W), dtype=bool)
+    b_border[b_rep] = True
+
+    bond_layout_pos = [None] * P  # [p] -> dict-free: local idx per W-index
+    for p in range(P):
+        owned_sel = np.nonzero(node_part[wdst] == p)[0]      # W-indices
+        pure = owned_sel[~b_border[owned_sel]]
+        brd = owned_sel[b_border[owned_sel]]
+        halo_sel = b_rep[q_rep == p]                         # W-indices, sorted by W then q? ->
+        # b_rep groups are emitted in W order; within q==p selection the
+        # order follows ascending W index (global edge id) — matches the
+        # sender's sorted-by-edge-id order below
+        halo_owner = node_part[wdst[halo_sel]]
+        sections = [W[pure], W[brd]]
+        counts = [len(pure), len(brd)] + [0] * (P - 1)
+        from_counts = []
+        halo_pos_start = len(pure) + len(brd)
+        from_slices = {}
+        off = halo_pos_start
+        for q in range(P):
+            sel_q = halo_sel[halo_owner == q] if q != p else np.zeros(0, np.int64)
+            sections.append(W[sel_q])
+            from_counts.append(len(sel_q))
+            if len(sel_q):
+                from_slices[q] = (off, off + len(sel_q))
+            off += len(sel_q)
+        b_edge = np.concatenate(sections).astype(np.int64)
+        markers = np.concatenate([[0], np.cumsum(counts + from_counts)]).astype(np.int64)
+        owned_b = int(markers[1 + P])
+        nil = np.zeros(len(b_edge), dtype=bool)
+        nil[:owned_b] = True
+        plan.bond_markers.append(markers)
+        plan.bond_global_edge.append(b_edge)
+        plan.bond_needs_in_line.append(nil)
+        for q, (a, b) in from_slices.items():
+            plan.bond_halo_recv[p][q] = np.arange(a, b, dtype=np.int64)
+        # local position of each owned W-bond (for the send lists)
+        pos = np.full(len(W), -1, dtype=np.int64)
+        pos[pure] = np.arange(len(pure))
+        pos[brd] = len(pure) + np.arange(len(brd))
+        bond_layout_pos[p] = pos
+
+        # edge<->bond mapping for locally computed bond nodes
+        e_g2l = np.full(nl.num_edges, -1, dtype=np.int64)
+        e_g2l[plan.edge_ids[p]] = np.arange(len(plan.edge_ids[p]))
+        local_e = e_g2l[b_edge[:owned_b]]
+        if np.any(local_e < 0):
+            raise PartitionError("internal error: owned bond node's edge not local")
+        plan.bond_mapping_edge.append(local_e)
+        plan.bond_mapping_bond.append(np.arange(owned_b, dtype=np.int64))
+
+        # line-graph join (shared with the slab path)
+        l_src, l_dst, centers = _line_graph_join(
+            plan.g2l[p], src, dst, b_edge, nil
+        )
+        plan.line_src.append(l_src)
+        plan.line_dst.append(l_dst)
+        plan.line_center_local.append(centers)
+
+    # sender side: owned bonds of p needed by q, ascending global edge id
+    owner_rep = node_part[wdst[b_rep]]
+    for p in range(P):
+        sel = owner_rep == p
+        for q in range(P):
+            if q == p:
+                continue
+            w_sel = b_rep[sel & (q_rep == q)]
+            if len(w_sel):
+                # ascending W order == ascending global edge id — matches the
+                # receiver's from_p section order
+                plan.bond_halo_send[p][q] = bond_layout_pos[p][w_sel]
+
+
+def _line_graph_join(g2l, src, dst, b_edge, needs_in_line):
+    """Directed line-graph join: a.dst == b.src, b locally computed, no
+    backtracking; returns (line_src, line_dst, center_local)."""
+    a_src, a_dst = src[b_edge], dst[b_edge]
+    nb = len(b_edge)
+    nil_idx = np.nonzero(needs_in_line)[0]
+    if nb == 0 or len(nil_idx) == 0:
+        z = np.zeros(0, np.int64)
+        return z, z.copy(), z.copy()
+    b_src_nil = a_src[nil_idx]
+    order = np.argsort(b_src_nil, kind="stable")
+    sorted_bsrc = b_src_nil[order]
+    grp_start = np.searchsorted(sorted_bsrc, a_dst, side="left")
+    grp_end = np.searchsorted(sorted_bsrc, a_dst, side="right")
+    cnt = grp_end - grp_start
+    total = int(cnt.sum())
+    if total == 0:
+        z = np.zeros(0, np.int64)
+        return z, z.copy(), z.copy()
+    a_rep = np.repeat(np.arange(nb), cnt)
+    starts_rep = np.repeat(grp_start, cnt)
+    csum = np.concatenate([[0], np.cumsum(cnt)])
+    intra = np.arange(total) - np.repeat(csum[:-1], cnt)
+    b_sel = nil_idx[order[starts_rep + intra]]
+    keep = a_dst[b_sel] != a_src[a_rep]
+    l_src = a_rep[keep].astype(np.int64)
+    l_dst = b_sel[keep].astype(np.int64)
+    centers = g2l[a_src[l_dst]]
+    if np.any(centers < 0):
+        raise PartitionError("internal error: line-graph center atom not local")
+    return l_src, l_dst, centers.astype(np.int64)
+
+
 def _single_partition_plan(nl: NeighborList, use_bond_graph: bool) -> PartitionPlan:
     n = nl.wrapped_cart.shape[0]
     plan = PartitionPlan(
@@ -354,33 +642,9 @@ def _build_bond_graph(plan: PartitionPlan, nl: NeighborList) -> None:
         plan.bond_mapping_bond.append(np.arange(owned_b, dtype=np.int64))
 
         # line-graph join: a.dst == b.src, b needs in-line, b.dst != a.src
-        a_src, a_dst = src[b_edge], dst[b_edge]
-        nil_idx = np.nonzero(needs_in_line)[0]
-        b_src_nil = a_src[nil_idx]
-        order = np.argsort(b_src_nil, kind="stable")
-        sorted_bsrc = b_src_nil[order]
-        # group starts per src node value via searchsorted
-        grp_start = np.searchsorted(sorted_bsrc, a_dst, side="left")
-        grp_end = np.searchsorted(sorted_bsrc, a_dst, side="right")
-        cnt = grp_end - grp_start
-        total = int(cnt.sum())
-        if total == 0:
-            plan.line_src.append(np.zeros(0, np.int64))
-            plan.line_dst.append(np.zeros(0, np.int64))
-            plan.line_center_local.append(np.zeros(0, np.int64))
-            continue
-        a_rep = np.repeat(np.arange(nb), cnt)
-        # intra-group offsets
-        starts_rep = np.repeat(grp_start, cnt)
-        csum = np.concatenate([[0], np.cumsum(cnt)])
-        intra = np.arange(total) - np.repeat(csum[:-1], cnt)
-        b_sel = nil_idx[order[starts_rep + intra]]
-        keep = a_dst[b_sel] != a_src[a_rep]  # no backtracking (by node id)
-        l_src = a_rep[keep]
-        l_dst = b_sel[keep]
-        centers = g2l[a_src[l_dst]]
-        if np.any(centers < 0):
-            raise PartitionError("internal error: line-graph center atom not local")
-        plan.line_src.append(l_src.astype(np.int64))
-        plan.line_dst.append(l_dst.astype(np.int64))
-        plan.line_center_local.append(centers.astype(np.int64))
+        l_src, l_dst, centers = _line_graph_join(
+            g2l, src, dst, b_edge, needs_in_line
+        )
+        plan.line_src.append(l_src)
+        plan.line_dst.append(l_dst)
+        plan.line_center_local.append(centers)

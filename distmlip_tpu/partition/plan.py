@@ -45,6 +45,19 @@ class PartitionPlan:
     dst_local: list = field(default_factory=list)
     edge_offsets: list = field(default_factory=list)   # [p] -> (E_p, 3) int32
 
+    # generalized halo lists (block plans). Slab plans leave these None and
+    # the halo tables are derived from the marker sections; block plans
+    # provide them explicitly because border nodes may be sent to MANY peers
+    # (send sets overlap, so they cannot be contiguous layout sections).
+    # halo_send[p][q] = local indices (owned rows of p) sent to q;
+    # halo_recv[p][q] = local slots of p receiving q's payload — both sides
+    # ordered by global id so the exchange is slot-aligned.
+    halo_send: list | None = None
+    halo_recv: list | None = None
+    bond_halo_send: list | None = None
+    bond_halo_recv: list | None = None
+    grid: tuple | None = None        # (gx, gy, gz) for block plans
+
     # bond graph (optional)
     has_bond_graph: bool = False
     bond_markers: list = field(default_factory=list)       # [p] -> (2P+2,)
@@ -84,7 +97,9 @@ class PartitionPlan:
     def summary(self) -> str:
         """Partition-balance report (reference dist.py:704-721 analogue)."""
         P = self.num_partitions
-        lines = [f"PartitionPlan(P={P}, axis={self.axis})"]
+        head = (f"PartitionPlan(P={P}, grid={self.grid})" if self.grid
+                else f"PartitionPlan(P={P}, axis={self.axis})")
+        lines = [head]
         for p in range(P):
             m = self.node_markers[p]
             owned = m[1 + P]

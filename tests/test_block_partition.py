@@ -1,0 +1,160 @@
+"""2-D/3-D block partitioning: invariants + distributed equivalence.
+
+The block decomposition generalizes the reference's 1-D slab rule (reference
+subgraph_creation_utils.c:1370-1456) to a (gx, gy, gz) grid; border nodes may
+be needed by up to 7 peers, so halo sets are derived exactly from the edge
+list and exchanged via per-shift ppermute tables (partition/partitioner.py
+build_block_plan).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distmlip_tpu.neighbors import neighbor_list_numpy
+from distmlip_tpu.partition import (PartitionError, build_partitioned_graph,
+                                    build_plan)
+from distmlip_tpu.parallel import graph_mesh, make_potential_fn
+from tests.utils import make_crystal, run_potential
+
+R, BR = 3.2, 2.7
+A_LAT = 3.6  # nn distance 2.55 A < BR -> non-empty bond/line graph
+
+
+def _plan(rng, grid, reps=(4, 4, 4), use_bond_graph=True):
+    cart, lattice, species = make_crystal(rng, reps=reps, a=A_LAT)
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], R, bond_r=BR)
+    P = int(np.prod(grid))
+    plan = build_plan(nl, lattice, [1, 1, 1], P, R, BR, use_bond_graph,
+                      grid=grid)
+    return plan, nl, cart, lattice, species
+
+
+@pytest.mark.parametrize("grid", [(2, 2, 2), (2, 2, 1), (1, 2, 2)])
+def test_block_plan_invariants(rng, grid):
+    plan, nl, cart, _, _ = _plan(rng, grid)
+    P = plan.num_partitions
+    N = len(cart)
+
+    # owned nodes form a disjoint cover
+    cover = np.concatenate(
+        [plan.global_ids[p][: plan.owned_counts[p]] for p in range(P)])
+    assert len(cover) == N and len(np.unique(cover)) == N
+
+    # edge union is exact (zero redundancy, each edge once)
+    ecover = np.concatenate(plan.edge_ids)
+    assert len(ecover) == nl.num_edges
+    assert len(np.unique(ecover)) == nl.num_edges
+
+    # every edge's src is visible in its partition, and halo recv slots
+    # carry exactly the gids the sender's send list names (slot-aligned)
+    for p in range(P):
+        assert np.all(plan.g2l[p][nl.src[plan.edge_ids[p]]] >= 0)
+    for p in range(P):
+        for q, slots in (plan.halo_recv[p] or {}).items():
+            send = plan.halo_send[q][p]
+            send_gids = plan.global_ids[q][send]
+            recv_gids = plan.global_ids[p][slots]
+            np.testing.assert_array_equal(send_gids, recv_gids)
+
+    # bond halo alignment (bond-node identity = global edge id)
+    for p in range(P):
+        for q, slots in (plan.bond_halo_recv[p] or {}).items():
+            send = plan.bond_halo_send[q][p]
+            np.testing.assert_array_equal(
+                plan.bond_global_edge[q][send],
+                plan.bond_global_edge[p][slots])
+
+    # corner blocks in 3-D must send some node to >1 peers (the capability
+    # the slab path lacks)
+    if np.prod(grid) == 8:
+        multi = 0
+        for p in range(P):
+            seen = {}
+            for q, idx in plan.halo_send[p].items():
+                for i in np.asarray(idx):
+                    seen[i] = seen.get(i, 0) + 1
+            multi += sum(1 for v in seen.values() if v > 1)
+        assert multi > 0
+
+
+def test_block_matches_single_device_chgnet(rng):
+    """CHGNet (bond graph + angles) on a 2x2x2 block mesh == single device."""
+    from distmlip_tpu.models import CHGNet, CHGNetConfig
+
+    cfg = CHGNetConfig(num_species=4, units=16, num_rbf=6, num_angle=3,
+                       num_blocks=3, cutoff=R, bond_cutoff=BR)
+    model = CHGNet(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    plan, nl, cart, lattice, species = _plan(rng, (2, 2, 2))
+    assert sum(len(x) for x in plan.line_src) > 100  # angles active
+
+    e1, f1, _ = run_potential(model.energy_fn, params, cart, lattice, species,
+                              R, 1, bond_r=BR, use_bond_graph=True)
+    graph, host = build_partitioned_graph(plan, nl, species, lattice)
+    pot = make_potential_fn(model.energy_fn, graph_mesh(8))
+    out = pot(params, graph, graph.positions)
+    e8 = float(out["energy"])
+    f8 = host.gather_owned(np.asarray(out["forces"]), len(cart))
+    assert np.abs(f1).max() > 1e-2
+    assert abs(e1 - e8) < 1e-4 * max(1.0, abs(e1))
+    np.testing.assert_allclose(f1, f8, atol=2e-4)
+
+
+def test_block_matches_single_device_mace(rng):
+    """MACE on a 2x2x2 block mesh == single device (VERDICT r2 item 5)."""
+    from distmlip_tpu.models import MACE, MACEConfig
+
+    cfg = MACEConfig(num_species=4, channels=16, l_max=2, a_lmax=2,
+                     hidden_lmax=1, correlation=3, num_interactions=2,
+                     num_bessel=6, radial_mlp=16, cutoff=R,
+                     avg_num_neighbors=12.0)
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    cart, lattice, species = make_crystal(rng, reps=(4, 4, 4), a=A_LAT)
+    e1, f1, s1 = run_potential(model.energy_fn, params, cart, lattice,
+                               species, R, 1)
+    e8, f8, s8 = run_potential(model.energy_fn, params, cart, lattice,
+                               species, R, 8, grid=(2, 2, 2))
+    assert np.abs(f1).max() > 1e-3
+    assert abs(e1 - e8) < 1e-4 * max(1.0, abs(e1))
+    np.testing.assert_allclose(f1, f8, atol=1e-4)
+    np.testing.assert_allclose(s1, s8, atol=1e-5)
+
+
+def test_block_grid_via_calculator(rng):
+    """DistPotential(partition_grid=...) end to end, including skin reuse."""
+    from distmlip_tpu.calculators import Atoms, DistPotential
+    from distmlip_tpu.models import TensorNet, TensorNetConfig
+
+    cfg = TensorNetConfig(num_species=4, units=16, num_rbf=6, num_layers=2,
+                          cutoff=R)
+    model = TensorNet(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    cart, lattice, species = make_crystal(rng, reps=(4, 4, 4), a=A_LAT)
+    atoms = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+    smap = np.arange(0, 10, dtype=np.int32) - 1
+
+    r1 = DistPotential(model, params, num_partitions=1,
+                       species_map=smap).calculate(atoms)
+    potg = DistPotential(model, params, partition_grid=(2, 2, 2),
+                         species_map=smap, skin=0.3)
+    rg = potg.calculate(atoms)
+    assert abs(r1["energy"] - rg["energy"]) < 1e-4 * max(1.0, abs(r1["energy"]))
+    np.testing.assert_allclose(r1["forces"], rg["forces"], atol=1e-4)
+    # skin reuse across a small move
+    atoms2 = Atoms(numbers=species + 1,
+                   positions=cart + rng.normal(0, 0.02, cart.shape),
+                   cell=lattice)
+    potg.calculate(atoms2)
+    assert potg.rebuild_count == 1  # cache hit
+
+    with pytest.raises(ValueError, match="partition_grid"):
+        DistPotential(model, params, num_partitions=4,
+                      partition_grid=(2, 2, 2), species_map=smap)
+
+
+def test_grid_product_mismatch_raises(rng):
+    _, nl, cart, lattice, _ = _plan(rng, (2, 2, 2))
+    with pytest.raises(PartitionError, match="grid"):
+        build_plan(nl, lattice, [1, 1, 1], 4, R, BR, False, grid=(2, 2, 2))

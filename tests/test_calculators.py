@@ -195,6 +195,33 @@ def test_stacked_ensemble_matches_sequential(rng):
                                atol=1e-8)
 
 
+def test_stacked_ensemble_matches_sequential_multipartition(rng):
+    """Multi-partition ensembles also run as ONE vmapped sharded program
+    (the vmap batches the whole shard_map'd graph-parallel step); results
+    must equal sequential members at P=2."""
+    import jax
+
+    from distmlip_tpu.calculators import Atoms, EnsemblePotential
+    from distmlip_tpu.models import TensorNet, TensorNetConfig
+    from tests.utils import make_crystal
+
+    cfg = TensorNetConfig(num_species=4, units=16, num_rbf=6, num_layers=1,
+                          cutoff=3.2)
+    model = TensorNet(cfg)
+    plist = [model.init(jax.random.PRNGKey(i)) for i in range(3)]
+    cart, lattice, species = make_crystal(rng, reps=(5, 3, 3))
+    atoms = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+    stacked = EnsemblePotential(model, plist, num_partitions=2)
+    assert stacked.stacked  # vmap path is now the multi-partition default
+    seq = EnsemblePotential(model, plist, num_partitions=2, stacked=False)
+    r1 = stacked.calculate(atoms)
+    r2 = seq.calculate(atoms)
+    assert abs(r1["energy"] - r2["energy"]) < 1e-5
+    np.testing.assert_allclose(r1["forces"], r2["forces"], atol=1e-5)
+    np.testing.assert_allclose(r1["energy_var"], r2["energy_var"], rtol=1e-4,
+                               atol=1e-8)
+
+
 def test_uma_predictor_task_routing(rng):
     """UMAPredictor: task name routes the dataset conditioning; different
     tasks give different energies on the same structure."""

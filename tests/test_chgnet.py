@@ -102,6 +102,32 @@ def test_energy_smooth_at_cutoff(rng, params):
     assert np.ptp(es) < 2e-3
 
 
+def test_skin_shell_edges_contribute_nothing(rng, params):
+    """A neighbor list built at cutoff+skin (MD reuse) must give the same
+    energy/forces as one built at the exact cutoffs: skin-shell edges and
+    bonds are masked out of every message path (matgl's graph simply does
+    not contain them)."""
+    cart, lattice, species = make_crystal(rng, reps=(3, 3, 3), a=A_LAT)
+    e0, f0, _ = _run(params, cart, lattice, species, 1, compute_stress=False)
+    from distmlip_tpu.neighbors import neighbor_list_numpy
+    from distmlip_tpu.parallel import make_potential_fn
+    from distmlip_tpu.partition import build_plan, build_partitioned_graph
+
+    skin = 0.4
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], CFG.cutoff + skin,
+                             bond_r=CFG.bond_cutoff + skin)
+    plan = build_plan(nl, lattice, [1, 1, 1], 1, CFG.cutoff + skin,
+                      CFG.bond_cutoff + skin, True)
+    graph, host = build_partitioned_graph(plan, nl, species, lattice)
+    assert int(np.asarray(graph.edge_mask).sum()) > 0
+    pot = make_potential_fn(MODEL.energy_fn, None, compute_stress=False)
+    out = pot(params, graph, graph.positions)
+    e1 = float(out["energy"])
+    f1 = host.gather_owned(np.asarray(out["forces"]), len(cart))
+    assert abs(e0 - e1) < 1e-4 * max(1.0, abs(e0))
+    np.testing.assert_allclose(f0, f1, atol=2e-4)
+
+
 def test_magmom_readout(rng, params):
     cart, lattice, species = make_crystal(rng, reps=(2, 2, 2), a=A_LAT)
     from distmlip_tpu.neighbors import neighbor_list_numpy

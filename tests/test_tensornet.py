@@ -21,8 +21,10 @@ def test_distributed_matches_single_device(rng, params):
     cart, lattice, species = make_crystal(rng, reps=(7, 4, 4))
     e1, f1, s1 = run_potential(MODEL.energy_fn, params, cart, lattice, species, CFG.cutoff, 1)
     e4, f4, s4 = run_potential(MODEL.energy_fn, params, cart, lattice, species, CFG.cutoff, 4)
-    # guard against a degenerate (position-independent) model making this vacuous
-    assert np.abs(f1).max() > 1e-2
+    # guard against a degenerate (position-independent) model making this
+    # vacuous (random-init forces are O(5e-3): the torchmd-net invariant
+    # readout is quadratic in the tensor features)
+    assert np.abs(f1).max() > 1e-3
     assert abs(e1 - e4) < 1e-4 * max(1.0, abs(e1))
     np.testing.assert_allclose(f1, f4, atol=1e-4)
     np.testing.assert_allclose(s1, s4, atol=1e-5)
@@ -78,7 +80,7 @@ def test_forces_match_finite_difference(rng, params):
             em, _ = energy(cm)
             f_fd = -(ep - em) / (2 * h)
             np.testing.assert_allclose(forces[atom, ax], f_fd, rtol=1e-5, atol=1e-7)
-        assert np.abs(forces).max() > 1e-2  # non-degenerate check
+        assert np.abs(forces).max() > 1e-3  # non-degenerate check
     finally:
         jax.config.update("jax_enable_x64", False)
 

@@ -32,13 +32,13 @@ _SHARED_CAPS = CapacityPolicy()
 _POT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
-def _potential_for(energy_fn, nparts, compute_stress):
+def _potential_for(energy_fn, nparts, compute_stress, grid=None):
     owner = getattr(energy_fn, "__self__", None)
     if owner is None:
         mesh = graph_mesh(nparts) if nparts > 1 else None
         return make_potential_fn(energy_fn, mesh, compute_stress=compute_stress)
     per_owner = _POT_CACHE.setdefault(owner, {})
-    key = (nparts, bool(compute_stress))
+    key = (nparts, bool(compute_stress), grid)
     if key not in per_owner:
         mesh = graph_mesh(nparts) if nparts > 1 else None
         per_owner[key] = make_potential_fn(
@@ -50,15 +50,16 @@ def _potential_for(energy_fn, nparts, compute_stress):
 def run_potential(
     energy_fn, params, cart, lattice, species, r, nparts,
     bond_r=0.0, use_bond_graph=False, caps=None, compute_stress=True,
-    dtype=np.float32,
+    dtype=np.float32, grid=None,
 ):
     """Full pipeline: neighbors -> partition -> graph -> potential."""
     nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], r, bond_r=bond_r)
-    plan = build_plan(nl, lattice, [1, 1, 1], nparts, r, bond_r, use_bond_graph)
+    plan = build_plan(nl, lattice, [1, 1, 1], nparts, r, bond_r,
+                      use_bond_graph, grid=grid)
     graph, host = build_partitioned_graph(
         plan, nl, species, lattice, caps=caps or _SHARED_CAPS, dtype=dtype
     )
-    pot = _potential_for(energy_fn, nparts, compute_stress)
+    pot = _potential_for(energy_fn, nparts, compute_stress, grid)
     out = pot(params, graph, graph.positions)
     forces = host.gather_owned(np.asarray(out["forces"]), len(cart))
     return float(out["energy"]), forces, np.asarray(out["stress"])

@@ -584,6 +584,36 @@ def _torch_gated_mlp_rules(sd: dict, prefix: str, path: tuple) -> list[Rule]:
             + _torch_mlp_rules(sd, prefix, path + ("gate",), seq="gates"))
 
 
+def _potential_extra_rules(sd: dict, species_ref_shape: tuple) -> list[Rule]:
+    """matgl ``Potential.state_dict()`` extras, shared by the chgnet and
+    tensornet mappings: ``element_refs.property_offset`` -> species_ref,
+    ``data_std`` -> data_std; a nonzero ``data_mean`` (a per-structure
+    offset this per-atom parameterization cannot carry exactly) is refused.
+    """
+    S = species_ref_shape[0]
+    rules: list[Rule] = []
+    if "element_refs.property_offset" in sd:
+        rules.append(Rule(
+            "element_refs.property_offset", ("species_ref", "w"),
+            lambda a: np.reshape(a, (-1,))[:S].reshape(species_ref_shape)))
+    if "data_std" in sd:
+        rules.append(Rule("data_std", ("data_std",),
+                          lambda a: np.reshape(a, ())))
+    if "data_mean" in sd:
+        def expect_zero(a):
+            if not np.allclose(np.asarray(a, dtype=np.float64), 0.0,
+                               atol=1e-12):
+                raise ValueError(
+                    f"data_mean = {np.ravel(a)} is nonzero: matgl applies it "
+                    f"once per structure, which this per-atom "
+                    f"parameterization cannot represent exactly — fold it "
+                    f"into element_refs upstream or re-reference the "
+                    f"checkpoint"
+                )
+        rules.append(Rule("data_mean", None, expect_zero))
+    return rules
+
+
 @register_mapping("chgnet")
 def chgnet_mapping(params, sd, model=None):
     """matgl ``CHGNet.state_dict()`` -> CHGNet params (the reference wraps
@@ -599,17 +629,6 @@ def chgnet_mapping(params, sd, model=None):
     S = np.shape(params["atom_emb"]["w"])[0]
     p = "model." if any(k.startswith("model.") for k in sd) else ""
     rules: list[Rule] = []
-
-    def expect_zero(name):
-        def check(a):
-            if not np.allclose(np.asarray(a, dtype=np.float64), 0.0, atol=1e-12):
-                raise ValueError(
-                    f"{name} = {np.ravel(a)} is nonzero: matgl applies it "
-                    f"once per structure, which this per-atom parameterization "
-                    f"cannot represent exactly — fold it into element_refs "
-                    f"upstream or re-reference the checkpoint"
-                )
-        return check
 
     # learnable basis frequencies (matgl RadialBessel/FourierExpansion)
     rules.append(Rule(p + "bond_expansion.frequencies", ("freq_bond",)))
@@ -711,15 +730,7 @@ def chgnet_mapping(params, sd, model=None):
 
     # Potential-level extras (matgl Potential.state_dict dumps)
     if p:
-        if "element_refs.property_offset" in sd:
-            rules.append(Rule(
-                "element_refs.property_offset", ("species_ref", "w"),
-                lambda a: np.reshape(a, (-1,))[:S].reshape(S, 1)))
-        if "data_std" in sd:
-            rules.append(Rule("data_std", ("data_std",),
-                              lambda a: np.reshape(a, ())))
-        if "data_mean" in sd:
-            rules.append(Rule("data_mean", None, expect_zero("data_mean")))
+        rules += _potential_extra_rules(sd, (S, 1))
     return rules
 
 
@@ -800,21 +811,7 @@ def tensornet_mapping(params, sd, model=None):
                 rules.append(Rule(key, None))
 
     if p:
-        if "element_refs.property_offset" in sd:
-            rules.append(Rule(
-                "element_refs.property_offset", ("species_ref", "w"),
-                lambda a: np.reshape(a, (-1,))[:S].reshape(S, 1)))
-        if "data_std" in sd:
-            rules.append(Rule("data_std", ("data_std",),
-                              lambda a: np.reshape(a, ())))
-        if "data_mean" in sd:
-            def expect_zero(a):
-                if not np.allclose(np.asarray(a, np.float64), 0.0, atol=1e-12):
-                    raise ValueError(
-                        "nonzero data_mean is a per-structure offset this "
-                        "per-atom parameterization cannot represent exactly"
-                    )
-            rules.append(Rule("data_mean", None, expect_zero))
+        rules += _potential_extra_rules(sd, (S, 1))
     return rules
 
 

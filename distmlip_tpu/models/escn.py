@@ -72,17 +72,30 @@ def _l_slices(l_max):
     return out
 
 
-def _m_index(l_max):
-    """For each m >= 0, the coefficient indices of (l, +m) and (l, -m).
+def _sh_local(l: int, m_signed: int) -> int:
+    """Within-block index of (l, m) in ops/so3's stacked SH layout.
 
-    Index of (l, m) inside the stacked layout is l^2 + l + m.
+    All l follow the standard order (m = -l..l, index l + m; cos-like A_m
+    components at +m, sin-like B_m at -m) EXCEPT l=1, which keeps e3nn's
+    (x, y, z) order: x is the cos-like m=1, y the sin-like m=1, and z the
+    true m=0 (z-rotation-invariant) component. The SO(2) machinery must pair
+    by the TRUE m-structure or gauge invariance of the edge frames breaks at
+    l=1 (caught by the float64 l_max=6 rotation test, round 3).
     """
+    if l == 1:
+        return {1: 0, -1: 1, 0: 2}[m_signed]
+    return l + m_signed
+
+
+def _m_index(l_max):
+    """For each m >= 0, the coefficient indices of (l, +m) and (l, -m)
+    in the stacked layout (block offset l^2 + convention-aware local)."""
     idx = {}
     for m in range(l_max + 1):
         plus, minus = [], []
         for l in range(m, l_max + 1):
-            plus.append(l * l + l + m)
-            minus.append(l * l + l - m)
+            plus.append(l * l + _sh_local(l, m))
+            minus.append(l * l + _sh_local(l, -m))
         idx[m] = (np.array(plus), np.array(minus))
     return idx
 
@@ -227,7 +240,8 @@ class ESCN:
         w_deg = linear(params["edge_deg"], x_edge).reshape(-1, C, cfg.l_max + 1)
         y_deg = jnp.zeros((w_deg.shape[0], C, S), dtype=dtype)
         for l in range(cfg.l_max + 1):
-            y_deg = y_deg.at[:, :, l * l + l].set(w_deg[:, :, l])  # (l, m=0)
+            y_deg = y_deg.at[:, :, l * l + _sh_local(l, 0)].set(
+                w_deg[:, :, l])  # (l, m=0)
         deg_msg = rotate(y_deg, transpose=True) * env[:, None, None]
         h = h + masked_segment_sum(
             deg_msg, lg.edge_dst, lg.n_cap, lg.edge_mask,

@@ -58,5 +58,81 @@ def config2():
     print("CONFIG 2 PASSED")
 
 
+def config3():
+    """MACE, ~200k-atom amorphous-SiO2-like box, 8-way partition.
+
+    On the CPU mesh the model is shrunk (channels=32, l_max=2, 1 interaction
+    — the partition/halo/capacity machinery still sees the full 200k-atom
+    graph); with DISTMLIP_REAL_DEVICES=1 and a TPU visible it runs the
+    MP-0-faithful shape (128ch, l_max=a_lmax=3, correlation 3) in bfloat16
+    single-chip — BASELINE.md config 3's memory proof.
+    """
+    from distmlip_tpu.models import MACE, MACEConfig
+
+    real = bool(os.environ.get("DISTMLIP_REAL_DEVICES"))
+    rng = np.random.default_rng(0)
+    # beta-cristobalite-ish SiO2: 24-atom cubic cell ~7.16 A, perturbed hard
+    unit = np.array([
+        [0.0, 0.0, 0.0], [0.5, 0.5, 0.0], [0.5, 0.0, 0.5], [0.0, 0.5, 0.5],
+        [0.25, 0.25, 0.25], [0.75, 0.75, 0.25], [0.75, 0.25, 0.75],
+        [0.25, 0.75, 0.75],
+    ])
+    si = unit
+    o = (np.concatenate([si + [0.125, 0.125, 0.125],
+                         si + [0.875, 0.875, 0.625]]) % 1.0)
+    frac_unit = np.concatenate([si, o])
+    numbers_unit = np.array([14] * len(si) + [8] * len(o))
+    reps = (20, 20, 20)  # 24 * 8000 = 192,000 atoms
+    frac, lattice = geometry.make_supercell(frac_unit, np.eye(3) * 7.16, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, 0.12, (len(frac), 3))
+    numbers = np.tile(numbers_unit, int(np.prod(reps)))
+    atoms = Atoms(numbers=numbers, positions=cart, cell=lattice)
+    smap = np.full(15, -1, np.int32)
+    smap[8], smap[14] = 0, 1
+    print(f"config 3: MACE, n_atoms = {len(atoms)} "
+          f"({'MP-0-faithful bf16, real devices' if real else 'small shape, CPU mesh'})")
+
+    if real:
+        cfg = MACEConfig(num_species=2, channels=128, l_max=3, a_lmax=3,
+                         hidden_lmax=1, correlation=3, num_interactions=2,
+                         num_bessel=8, radial_mlp=64, cutoff=6.0,
+                         avg_num_neighbors=60.0, dtype="bfloat16")
+        model = MACE(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        pot = DistPotential(model, params, num_partitions=1, species_map=smap)
+        for tag in ("cold", "warm", "warm"):
+            t0 = time.time()
+            res = pot.calculate(atoms)
+            print(f"single-chip {tag}: E={res['energy']:.2f} "
+                  f"{time.time() - t0:.2f}s "
+                  f"({len(atoms) / (time.time() - t0):.0f} atoms/s)")
+        return
+
+    cfg = MACEConfig(num_species=2, channels=32, l_max=2, a_lmax=2,
+                     hidden_lmax=1, correlation=3, num_interactions=2,
+                     num_bessel=6, radial_mlp=32, cutoff=5.0,
+                     avg_num_neighbors=40.0)
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    results = {}
+    for P in (8, 1):
+        t0 = time.time()
+        pot = DistPotential(model, params, num_partitions=P, species_map=smap)
+        results[P] = pot.calculate(atoms)
+        print(f"{P}-way: E={results[P]['energy']:.4f} "
+              f"({time.time() - t0:.0f}s incl compile)")
+    de = abs(results[8]["energy"] - results[1]["energy"]) / len(atoms)
+    df = np.abs(results[8]["forces"] - results[1]["forces"]).max()
+    print(f"8-way vs 1-way: dE/atom={de:.2e} eV  dF_max={df:.2e} eV/Å")
+    assert de < 1e-5 and df < 1e-3
+    print("CONFIG 3 PASSED")
+
+
 if __name__ == "__main__":
-    config2()
+    import sys
+
+    which = "2"
+    if "--config" in sys.argv:
+        which = sys.argv[sys.argv.index("--config") + 1]
+    {"2": config2, "3": config3}[which]()

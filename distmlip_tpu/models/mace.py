@@ -7,7 +7,12 @@ interaction -> product -> readout loop with an atom_transfer after every
 interaction). Built entirely on this repo's SO(3) module (real spherical
 harmonics + real coupling tensors, ops/so3.py) instead of e3nn.
 
-Feature layout: equivariant node features are a dict {l: (N, C, 2l+1)}.
+Feature layout: equivariant node features are a dict {l: (N, 2l+1, C)} —
+channels LAST so the C=128 axis lands in the TPU lane dimension. TPU
+arrays tile their trailing two axes to (sublane, lane)=(8|16, 128); with
+channels last the small spherical axes (3..16) pad only the sublane axis
+(<=2x) instead of the lane axis (8..32x), which round-3 profiling showed
+was inflating every hot tensor's HBM traffic by an order of magnitude.
 Message construction (density projection):
     A_i^{l3} = (1/avg_n) sum_j sum_{l1,l2} R^{l1l2l3}(r_ij) *
                CG[(l1,l2,l3)] (h_j^{l1}, Y^{l2}(r_ij))
@@ -86,7 +91,7 @@ class MACEConfig:
                              # radial-weight memory regardless of system size
                              # (0 disables chunking)
     node_chunk: int = 4096   # same for the per-node symmetric contraction
-                             # (the Horner intermediates are (n, C, d, S, S))
+                             # (the Horner intermediates are (n, d, S, S, C))
     dtype: str = "float32"
 
 
@@ -119,7 +124,8 @@ def _projection_tables(h_ls, l_max, paths):
 
         W[(l_h m) * S_Y + (l_Y n), q(path, p)] = CG^{l_h l_Y l_out}[m, n, p]
 
-    Per edge: outer(h_src, Y) (E, C, S_h*S_Y) @ W (S_h*S_Y, Q) — one matmul
+    Per edge: outer(h_src, Y) (E, S_h*S_Y, C) contracted with W (S_h*S_Y, Q)
+    along the S_h*S_Y axis — one matmul
     covering every path, instead of the per-path ``ecm,en,mnp->ecp`` einsums
     that lowered to gather/VPU work (round-1 bottleneck, ROADMAP lever 1).
 
@@ -300,7 +306,7 @@ class MACE:
         o = 0
         for l in ls:
             d = C * (2 * l + 1)
-            out[l] = flat[:, o : o + d].reshape(-1, C, 2 * l + 1)
+            out[l] = flat[:, o : o + d].reshape(-1, 2 * l + 1, C)
             o += d
         return out
 
@@ -333,7 +339,7 @@ class MACE:
         Y = {l: spherical_harmonics(l, rhat) for l in range(cfg.l_max + 1)}
 
         z = lg.species
-        h = {0: params["species_emb"]["w"][z][:, :, None].astype(dtype)}
+        h = {0: params["species_emb"]["w"][z][:, None, :].astype(dtype)}
         h = self._unpack(lg.halo_exchange(self._pack(h)), [0], C)
 
         head = cfg.head
@@ -357,7 +363,7 @@ class MACE:
             h = self._unpack(lg.halo_exchange(self._pack(h)), self.h_ls_out[t], C)
 
             # invariant readout (head column selected)
-            scalars = h[0][:, :, 0]
+            scalars = h[0][:, 0, :]
             if t == cfg.num_interactions - 1:
                 r_out = mlp(inter["readout"], scalars)[:, head]
             else:
@@ -417,13 +423,13 @@ class MACE:
         q_path = jnp.asarray(proj["q_path"])              # (Q,)
         nQ = proj["W"].shape[1]
 
-        # sender features, channel-mixed per l, packed (N, C, S_h)
+        # sender features, channel-mixed per l, packed (N, S_h, C)
         hu = jnp.concatenate(
             [
-                jnp.einsum("ncm,cd->ndm", h[l], inter["lin_up"][str(l)]["w"])
+                jnp.einsum("nmc,cd->nmd", h[l], inter["lin_up"][str(l)]["w"])
                 for l in h_ls
             ],
-            axis=-1,
+            axis=1,
         )
         Y_full = jnp.concatenate(
             [Y[l] for l in range(cfg.l_max + 1)], axis=-1
@@ -460,9 +466,13 @@ class MACE:
         def chunk_body(A_acc, xs):
             srcc, dstc, maskc, Yc, besc = xs
             Rc = mlp(inter["radial"], besc).reshape(chunk, len(paths), C)
-            outer = hu[srcc][:, :, :, None] * Yc[:, None, None, :]
-            M = outer.reshape(chunk, C, -1) @ Wp          # (E_c, C, Q) [MXU]
-            M = M * jnp.swapaxes(Rc[:, q_path, :], 1, 2)  # per-path radial
+            # outer[e, m, n, c] = h_src[e, m, c] * Y[e, n]: trailing axes
+            # (S_Y, C) tile the (sublane, lane) grid exactly
+            outer = hu[srcc][:, :, None, :] * Yc[:, None, :, None]
+            M = jnp.einsum(                               # (E_c, Q, C) [MXU]
+                "ekc,kq->eqc", outer.reshape(chunk, -1, C), Wp
+            )
+            M = M * Rc[:, q_path, :]                      # per-path radial
             return (
                 A_acc
                 + masked_segment_sum(
@@ -471,7 +481,7 @@ class MACE:
                 None,
             )
 
-        A0 = jnp.zeros((n_nodes, C, nQ), dtype=dtype)
+        A0 = jnp.zeros((n_nodes, nQ, C), dtype=dtype)
         if K == 1:
             A_all, _ = chunk_body(
                 A0, (src_ch[0], dst_ch[0], mask_ch[0], Y_ch[0], bes_ch[0])
@@ -486,18 +496,18 @@ class MACE:
         inv_avg = jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)
         A = {
             l: jnp.einsum(
-                "ncpm,pcd->ndm",
-                A_all[:, :, proj["lo_cols"][l]] * inv_avg,
+                "npmc,pcd->nmd",
+                A_all[:, proj["lo_cols"][l]] * inv_avg,
                 inter["lin_A"][str(l)].astype(dtype),
             )
             for l in self.a_ls
         }
 
         # ---- symmetric contraction (ACE product basis, U-matrix form) ----
-        # node-chunked: the Horner intermediates are (n, C, d, S, S)
-        A_flat = jnp.concatenate([A[l] for l in self.a_ls], axis=-1)  # (N,C,S_A)
+        # node-chunked: the Horner intermediates are (n, d, S, S, C)
+        A_flat = jnp.concatenate([A[l] for l in self.a_ls], axis=1)  # (N,S_A,C)
         h_in_ls = [l for l in h_ls if l in h]
-        h_flat = jnp.concatenate([h[l] for l in h_in_ls], axis=-1)
+        h_flat = jnp.concatenate([h[l] for l in h_in_ls], axis=1)
         nchunk = cfg.node_chunk if cfg.node_chunk > 0 else n_nodes
         nchunk = min(nchunk, n_nodes)
         Kn = -(-n_nodes // nchunk)
@@ -509,9 +519,9 @@ class MACE:
             widths = [(0, padn)] + [(0, 0)] * (x.ndim - 1)
             return jnp.pad(x, widths)
 
-        A_ch = padn_c(A_flat).reshape(Kn, nchunk, C, -1)
+        A_ch = padn_c(A_flat).reshape(Kn, nchunk, -1, C)
         z_ch = padn_c(z).reshape(Kn, nchunk)
-        h_ch = padn_c(h_flat).reshape(Kn, nchunk, C, -1)
+        h_ch = padn_c(h_flat).reshape(Kn, nchunk, -1, C)
 
         def node_body(_, xs):
             Ac, zc, hc = xs
@@ -520,35 +530,36 @@ class MACE:
                 B = self._sym_contract(
                     inter["product"][str(l)], self.prod_U[l], Ac, zc, dtype
                 )
-                m = jnp.einsum("ncm,cd->ndm", B, inter["lin_msg"][str(l)]["w"])
+                m = jnp.einsum("nmc,cd->nmd", B, inter["lin_msg"][str(l)]["w"])
                 if l in h_in_ls and str(l) in inter["lin_res"]:
                     off = sum(2 * ll + 1 for ll in h_in_ls if ll < l)
-                    hl = hc[:, :, off : off + 2 * l + 1]
+                    hl = hc[:, off : off + 2 * l + 1, :]
                     Wr = inter["lin_res"][str(l)][zc].astype(dtype)  # (n,C,C)
-                    m = m + jnp.einsum("ncm,ncd->ndm", hl, Wr)
+                    m = m + jnp.einsum("nmc,ncd->nmd", hl, Wr)
                 outs.append(m)
-            return None, jnp.concatenate(outs, axis=-1)
+            return None, jnp.concatenate(outs, axis=1)
 
         if Kn == 1:
             _, out_flat = node_body(None, (A_ch[0], z_ch[0], h_ch[0]))
         else:
             body = jax.checkpoint(node_body) if cfg.remat else node_body
             _, out_flat = jax.lax.scan(body, None, (A_ch, z_ch, h_ch))
-            out_flat = out_flat.reshape(Kn * nchunk, C, -1)[:n_nodes]
+            out_flat = out_flat.reshape(Kn * nchunk, -1, C)[:n_nodes]
 
         h_new = {}
         o = 0
         for l in out_ls:
             d = 2 * l + 1
-            h_new[l] = out_flat[..., o : o + d]
+            h_new[l] = out_flat[:, o : o + d, :]
             o += d
         return h_new
 
     def _sym_contract(self, wts, Us, Ac, zc, dtype):
-        """B(A)[n, c, d] = sum_nu W_nu[z_n] . U_nu . A^(x nu) — evaluated
+        """B(A)[n, d, c] = sum_nu W_nu[z_n] . U_nu . A^(x nu) — evaluated
         highest correlation first in Horner form (mace's contraction order:
         each step adds the next-lower U.W block, then contracts one A index).
-        Ac: (n, C, S_A); returns (n, C, 2l+1)."""
+        Ac: (n, S_A, C); returns (n, 2l+1, C). Channels stay in the trailing
+        (lane) axis through every intermediate."""
         numax = max(nu for nu, U in Us.items() if U is not None)
         letters = "uvwxy"
         # U stored (S,)*nu + (d, k) -> transpose to (d, S..., k)
@@ -560,17 +571,17 @@ class MACE:
         w = {nu: wts[f"w{nu}"][zc].astype(dtype) for nu in U_t}  # (n, k, C)
 
         s_in = letters[: numax - 1]
-        # G[n,k,q,c] = w[n,k,c] A[n,c,q]: fold the path and last tensor index
+        # G[n,k,q,c] = w[n,k,c] A[n,q,c]: fold the path and last tensor index
         # into one MXU contraction of U against G
-        G = jnp.einsum("nkc,ncq->nkqc", w[numax], Ac)
-        t = jnp.einsum(f"d{s_in}qk,nkqc->ncd{s_in}", U_t[numax], G)
+        G = jnp.einsum("nkc,nqc->nkqc", w[numax], Ac)
+        t = jnp.einsum(f"d{s_in}qk,nkqc->nd{s_in}c", U_t[numax], G)
         for nu in range(numax - 1, 0, -1):
             s_cur = letters[:nu]
             if nu in U_t:
                 t = t + jnp.einsum(
-                    f"d{s_cur}k,nkc->ncd{s_cur}", U_t[nu], w[nu]
+                    f"d{s_cur}k,nkc->nd{s_cur}c", U_t[nu], w[nu]
                 )
             t = jnp.einsum(
-                f"ncd{s_cur},nc{s_cur[-1]}->ncd{s_cur[:-1]}", t, Ac
+                f"nd{s_cur}c,n{s_cur[-1]}c->nd{s_cur[:-1]}c", t, Ac
             )
         return t

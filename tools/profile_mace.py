@@ -1,0 +1,159 @@
+"""Attribute the MACE bench step time to its pieces, on the real chip.
+
+Times separately-jitted stages at the exact bench shapes: energy-only
+forward vs grad step, the density-projection edge scan, the symmetric
+contraction, the radial MLP, the source-feature gather, and the sorted
+segment sum. Prints one JSON line per probe.
+"""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_fn(fn, *args, reps=3):
+    import jax
+
+    out = jax.block_until_ready(fn(*args))  # compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    del out
+    return float(np.median(times)) * 1e3
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from distmlip_tpu import geometry
+    from distmlip_tpu.calculators import Atoms, DistPotential
+    from distmlip_tpu.models import MACE, MACEConfig
+    from distmlip_tpu.models.mace import MACEConfig as _MC
+
+    rng = np.random.default_rng(0)
+    reps = 16
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9, (reps, reps, reps))
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, 0.04, (len(frac), 3))
+    atoms = Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
+
+    cfg = MACEConfig(
+        num_species=95, channels=128, l_max=3, a_lmax=3, hidden_lmax=1,
+        correlation=3, num_interactions=2, num_bessel=8, radial_mlp=64,
+        cutoff=5.0, avg_num_neighbors=14.0, dtype="bfloat16",
+    )
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    pot = DistPotential(model, params, num_partitions=1, compute_stress=True,
+                        skin=0.5, compute_dtype="bfloat16")
+    pot.calculate(atoms)
+    graph, host, _, *_ = pot._cache
+    positions = jnp.asarray(graph.positions)
+    n_cap = graph.positions.shape[1]
+    e_cap = graph.edge_src.shape[1]
+    print(json.dumps({"probe": "shapes", "n_cap": int(n_cap),
+                      "e_cap": int(e_cap)}), flush=True)
+
+    model_b = pot.model  # bf16 model
+    from distmlip_tpu.parallel import make_total_energy
+    total_e = make_total_energy(model_b.energy_fn, None)
+
+    # full potential step (E+F+stress) as the calculator runs it
+    t = bench_fn(lambda p: pot._potential(p, graph, positions), pot.params)
+    print(json.dumps({"probe": "full_step_EFS", "ms": round(t, 1)}), flush=True)
+
+    strain = jnp.zeros((3, 3), dtype=positions.dtype)
+    e_only = jax.jit(lambda p, pos: total_e(p, graph, pos, strain))
+    t = bench_fn(e_only, pot.params, positions)
+    print(json.dumps({"probe": "energy_only_fwd", "ms": round(t, 1)}), flush=True)
+
+    ef = jax.jit(jax.value_and_grad(lambda p, pos: total_e(p, graph, pos, strain),
+                                    argnums=1))
+    t = bench_fn(ef, pot.params, positions)
+    print(json.dumps({"probe": "energy_forces_noStress", "ms": round(t, 1)}),
+          flush=True)
+
+    # ---- stage probes at real shapes ----
+    from distmlip_tpu.parallel.halo import local_graph_from_stacked
+    lg, _ = local_graph_from_stacked(jax.tree.map(lambda x: jnp.asarray(x), graph),
+                                     None)
+    pos = positions[0]
+    dtype = jnp.bfloat16
+    C = cfg.channels
+
+    vec = lg.edge_vectors(pos)
+    d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
+    from distmlip_tpu.ops import radial as radial_ops
+    from distmlip_tpu.ops.so3 import spherical_harmonics
+    rhat = vec / jnp.maximum(d, 1e-9)[:, None]
+    env = (radial_ops.polynomial_cutoff(d, cfg.cutoff, p=cfg.cutoff_p)
+           * lg.edge_mask).astype(dtype)
+    bessel = (radial_ops.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel)
+              * env[:, None]).astype(dtype)
+    Y = {l: spherical_harmonics(l, rhat).astype(dtype)
+         for l in range(cfg.l_max + 1)}
+    z = lg.species
+
+    bessel = jax.block_until_ready(bessel)
+
+    # interaction t (0 and 1) forward alone, and its grad wrt positions-free
+    # inputs (h), to separate fwd/bwd cost
+    for t_idx in (0, 1):
+        h_ls = model_b.h_ls_in[t_idx]
+        h = {l: jnp.asarray(rng.standard_normal((n_cap, 2 * l + 1, C)),
+                            dtype=dtype) for l in h_ls}
+        inter = jax.tree.map(jnp.asarray, pot.params["interactions"][t_idx])
+
+        fwd = jax.jit(lambda i, hh: model_b._interaction(
+            i, hh, lg=lg, Y=Y, bessel=bessel, z=z, t=t_idx))
+        ms = bench_fn(fwd, inter, h)
+        print(json.dumps({"probe": f"interaction{t_idx}_fwd", "ms": round(ms, 1)}),
+              flush=True)
+
+        g = jax.jit(jax.grad(lambda i, hh: jnp.sum(
+            model_b._interaction(i, hh, lg=lg, Y=Y, bessel=bessel, z=z,
+                                 t=t_idx)[0].astype(jnp.float32)),
+            argnums=(0, 1)))
+        ms = bench_fn(g, inter, h)
+        print(json.dumps({"probe": f"interaction{t_idx}_grad", "ms": round(ms, 1)}),
+              flush=True)
+
+    # radial MLP at full edge count
+    from distmlip_tpu.ops.nn import mlp
+    inter1 = jax.tree.map(lambda x: jnp.asarray(x).astype(dtype)
+                          if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                          else jnp.asarray(x),
+                          pot.params["interactions"][1])
+    rad = jax.jit(lambda b: mlp(inter1["radial"], b))
+    ms = bench_fn(rad, bessel)
+    print(json.dumps({"probe": "radial_mlp_full_edges", "ms": round(ms, 1)}),
+          flush=True)
+
+    # gather at full edge count: (E, 4, C) from (N, 4, C) (channels-last)
+    hu = jnp.asarray(rng.standard_normal((n_cap, 4, C)), dtype=dtype)
+    gath = jax.jit(lambda h_, s_: h_[s_])
+    ms = bench_fn(gath, hu, lg.edge_src)
+    print(json.dumps({"probe": "gather_full_edges", "ms": round(ms, 1)}),
+          flush=True)
+
+    # sorted segment sum at full edge count, Q=40 (channels-last)
+    from distmlip_tpu.ops.segment import masked_segment_sum
+    M = jnp.asarray(rng.standard_normal((e_cap, 40, C)), dtype=dtype)
+    seg = jax.jit(partial(masked_segment_sum, num_segments=n_cap,
+                          indices_are_sorted=True))
+    ms = bench_fn(lambda m: seg(m, lg.edge_dst, mask=lg.edge_mask), M)
+    print(json.dumps({"probe": "segment_sum_full_edges_Q40", "ms": round(ms, 1)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""On-chip tuning sweep for the MACE bench config (VERDICT r2 item 1).
+
+Builds the exact bench.py system (16384-atom perturbed Si, MP-0-faithful
+MACE) and times steady-state MD steps across a grid of the performance
+knobs: remat, edge_chunk, node_chunk, and stress on/off. Prints one line
+per config; run on the real chip.
+
+Usage: python tools/tune_mace.py [--quick]
+"""
+
+import dataclasses
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_atoms(reps=16):
+    from distmlip_tpu import geometry
+    from distmlip_tpu.calculators import Atoms
+
+    rng = np.random.default_rng(0)
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9, (reps, reps, reps))
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, 0.04, (len(frac), 3))
+    return Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice), rng
+
+
+def time_config(atoms, rng, *, remat, edge_chunk, node_chunk,
+                compute_stress=True, dtype="bfloat16", steps=5):
+    import jax
+
+    from distmlip_tpu.calculators import DistPotential
+    from distmlip_tpu.models import MACE, MACEConfig
+
+    cfg = MACEConfig(
+        num_species=95, channels=128, l_max=3, a_lmax=3, hidden_lmax=1,
+        correlation=3, num_interactions=2, num_bessel=8, radial_mlp=64,
+        cutoff=5.0, avg_num_neighbors=14.0,
+        remat=remat, edge_chunk=edge_chunk, node_chunk=node_chunk,
+    )
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    pot = DistPotential(model, params, num_partitions=len(jax.devices()),
+                        compute_stress=compute_stress, skin=0.5,
+                        compute_dtype=dtype)
+    pos0 = atoms.positions.copy()
+    t0 = time.perf_counter()
+    pot.calculate(atoms)  # compile + first step
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(steps):
+        atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
+        t0 = time.perf_counter()
+        pot.calculate(atoms)
+        times.append(time.perf_counter() - t0)
+    atoms.positions[:] = pos0  # keep the skin cache comparable across configs
+    dt = float(np.median(times))
+    return {
+        "remat": remat, "edge_chunk": edge_chunk, "node_chunk": node_chunk,
+        "stress": compute_stress, "dtype": dtype,
+        "step_ms": round(dt * 1e3, 1),
+        "atoms_per_s": round(len(atoms) / dt, 1),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def main():
+    quick = "--quick" in sys.argv
+    atoms, rng = build_atoms()
+    configs = [
+        # (remat, edge_chunk, node_chunk, stress, dtype)
+        (True, 32768, 4096, True, "bfloat16"),    # bench default (baseline)
+        (False, 32768, 4096, True, "bfloat16"),   # no remat
+        (False, 65536, 4096, True, "bfloat16"),
+        (False, 131072, 4096, True, "bfloat16"),
+        (False, 32768, 16384, True, "bfloat16"),  # single node chunk
+        (False, 65536, 16384, True, "bfloat16"),
+        (False, 32768, 4096, False, "bfloat16"),  # stress off (ablation)
+        (True, 32768, 4096, True, "float32"),     # precision ablation
+    ]
+    if quick:
+        configs = configs[:2]
+    for remat, ec, nc, stress, dt in configs:
+        try:
+            r = time_config(atoms, rng, remat=remat, edge_chunk=ec,
+                            node_chunk=nc, compute_stress=stress, dtype=dt)
+        except Exception as e:  # noqa: BLE001 - OOM/compile failures expected
+            r = {"remat": remat, "edge_chunk": ec, "node_chunk": nc,
+                 "stress": stress, "dtype": dt,
+                 "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -96,8 +96,30 @@ def main():
                         skin=float(os.environ.get("BENCH_SKIN", "0.5")),
                         compute_dtype=bench_dtype)
 
-    # warmup (compile)
+    # warmup (compile) under a watchdog: a wedged chip grant can pass the
+    # claim (jax.devices() returns) yet hang the first compile/execute
+    # forever (round-3 lesson) — emit structured failure instead of letting
+    # the driver record a bare timeout with no JSON
+    import threading
+
+    warm_timeout = float(os.environ.get("BENCH_WARMUP_TIMEOUT_S", "600"))
+    done = threading.Event()
+
+    def _watchdog():
+        if not done.wait(warm_timeout):
+            print(json.dumps({
+                "metric": "mace_mp0_md_step_atoms_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "atoms/s",
+                "vs_baseline": 0.0,
+                "error": f"backend wedged: warmup compile/execute exceeded "
+                         f"{warm_timeout:.0f}s (chip claimed but not serving)",
+            }), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
     pot.calculate(atoms)
+    done.set()
     # steady state: perturb positions each step like MD
     times = []
     for _ in range(steps):

@@ -10,8 +10,10 @@ Wigner matrices are built on-device by the exact CG recursion
 (ops/so3.wigner_d_batch) instead of precomputed Jd tables, and the whole
 layer loop is one SPMD program.
 
-Node features: h (N, C, S) — S = (l_max+1)^2 stacked real spherical-harmonic
-coefficients (l <= 6). Each edge: rotate the sender
+Node features: h (N, S, C) — S = (l_max+1)^2 stacked real spherical-harmonic
+coefficients (l <= 6), channels LAST so C lands in the TPU lane dimension
+(S=9..49 in the lane axis would pad to 128 and inflate HBM traffic 2.6-14x;
+see the MACE channels-last note, models/mace.py). Each edge: rotate the sender
 features into the edge-aligned frame (edge direction -> z), run SO(2)
 convolutions (per-|m| channel-mixing linear maps with the (+m, -m) complex
 pair structure, which commutes with rotations about z), rotate back,
@@ -189,14 +191,14 @@ class ESCN:
         sl = _l_slices(cfg.l_max)
 
         def rotate(hvecs, transpose=False):
-            # hvecs: (E, C, S) in source frame -> rotated per l block
+            # hvecs: (E, S, C) in source frame -> rotated per l block
             parts = []
             for l in range(cfg.l_max + 1):
                 Dl = D[l].astype(hvecs.dtype)
                 if transpose:
                     Dl = jnp.swapaxes(Dl, -1, -2)
-                parts.append(jnp.einsum("epq,ecq->ecp", Dl, hvecs[:, :, sl[l]]))
-            return jnp.concatenate(parts, axis=-1)
+                parts.append(jnp.einsum("epq,eqc->epc", Dl, hvecs[:, sl[l], :]))
+            return jnp.concatenate(parts, axis=1)
 
         z = lg.species
         zemb = params["species_emb"]["w"][z].astype(dtype)  # (N, C)
@@ -220,10 +222,10 @@ class ESCN:
             ).astype(dtype),
         )  # (C,)
 
-        h = jnp.zeros((positions.shape[0], C, S), dtype=dtype)
+        h = jnp.zeros((positions.shape[0], S, C), dtype=dtype)
         # node scalars: species embedding + the system (csd) embedding
         # (ref escn_md.py:330 x_message[:, 0, :] += sys_node_embedding)
-        h = h.at[:, :, 0].set(zemb + linear(params["sys_node_proj"], csd)[None, :])
+        h = h.at[:, 0, :].set(zemb + linear(params["sys_node_proj"], csd)[None, :])
 
         # edge-degree embedding: per-edge scalars (distance expansion +
         # source/target species embeddings) -> m=0 coefficients in the edge
@@ -237,11 +239,11 @@ class ESCN:
             ],
             axis=-1,
         )
-        w_deg = linear(params["edge_deg"], x_edge).reshape(-1, C, cfg.l_max + 1)
-        y_deg = jnp.zeros((w_deg.shape[0], C, S), dtype=dtype)
+        w_deg = linear(params["edge_deg"], x_edge).reshape(-1, cfg.l_max + 1, C)
+        y_deg = jnp.zeros((w_deg.shape[0], S, C), dtype=dtype)
         for l in range(cfg.l_max + 1):
-            y_deg = y_deg.at[:, :, l * l + _sh_local(l, 0)].set(
-                w_deg[:, :, l])  # (l, m=0)
+            y_deg = y_deg.at[:, l * l + _sh_local(l, 0), :].set(
+                w_deg[:, l, :])  # (l, m=0)
         deg_msg = rotate(y_deg, transpose=True) * env[:, None, None]
         h = h + masked_segment_sum(
             deg_msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
@@ -270,28 +272,29 @@ class ESCN:
             ef = jnp.concatenate([bessel, zemb[lg.edge_src], zemb[lg.edge_dst]], axis=-1)
             g_e = mlp(layer["edge_mlp"], ef) * env[:, None]  # (E, C)
 
-            h_rot = rotate(h[lg.edge_src])  # (E, C, S)
+            h_rot = rotate(h[lg.edge_src])  # (E, S, C)
             # inject edge scalars into the l=0 channel (distance/species info)
-            h_rot = h_rot.at[:, :, 0].add(g_e)
+            h_rot = h_rot.at[:, 0, :].add(g_e)
 
-            # SO(2) convolutions per |m|
+            # SO(2) convolutions per |m|; the per-m feature vector flattens
+            # (nl, C) row-major — the (d, d) weight basis follows this order
             y = jnp.zeros_like(h_rot)
             for m in range(cfg.l_max + 1):
                 plus, minus = self.m_idx[m]
                 nl = len(plus)
                 if m == 0:
                     W = jnp.einsum("k,kab->ab", mole, layer["so2"]["m0"])
-                    f = h_rot[:, :, plus].reshape(-1, C * nl)
-                    y = y.at[:, :, plus].set((f @ W).reshape(-1, C, nl))
+                    f = h_rot[:, plus, :].reshape(-1, nl * C)
+                    y = y.at[:, plus, :].set((f @ W).reshape(-1, nl, C))
                 else:
                     Wr = jnp.einsum("k,kab->ab", mole, layer["so2"][f"m{m}r"])
                     Wi = jnp.einsum("k,kab->ab", mole, layer["so2"][f"m{m}i"])
-                    fp = h_rot[:, :, plus].reshape(-1, C * nl)
-                    fm = h_rot[:, :, minus].reshape(-1, C * nl)
+                    fp = h_rot[:, plus, :].reshape(-1, nl * C)
+                    fm = h_rot[:, minus, :].reshape(-1, nl * C)
                     yp = fp @ Wr - fm @ Wi
                     ym = fp @ Wi + fm @ Wr
-                    y = y.at[:, :, plus].set(yp.reshape(-1, C, nl))
-                    y = y.at[:, :, minus].set(ym.reshape(-1, C, nl))
+                    y = y.at[:, plus, :].set(yp.reshape(-1, nl, C))
+                    y = y.at[:, minus, :].set(ym.reshape(-1, nl, C))
 
             msg = rotate(y, transpose=True) * env[:, None, None]
             agg = masked_segment_sum(msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
@@ -299,13 +302,13 @@ class ESCN:
             agg = agg * inv_avg
 
             # gated nonlinearity: scalars via MLP, higher l scaled by gates
-            s = agg[:, :, 0]
+            s = agg[:, 0, :]
             gates = jax.nn.sigmoid(mlp(layer["gate_mlp"], s))
-            upd = agg * gates[:, :, None]
-            upd = upd.at[:, :, 0].set(mlp(layer["scalar_mlp"], s))
+            upd = agg * gates[:, None, :]
+            upd = upd.at[:, 0, :].set(mlp(layer["scalar_mlp"], s))
             h = h + upd
             h = lg.halo_exchange(h)
 
         # energy sum in the positions dtype (bf16 is too coarse for it)
-        e_atom = mlp(params["energy_mlp"], h[:, :, 0])[:, 0].astype(positions.dtype)
+        e_atom = mlp(params["energy_mlp"], h[:, 0, :])[:, 0].astype(positions.dtype)
         return e_atom + params["species_ref"]["w"][z].astype(positions.dtype)

@@ -10,8 +10,12 @@ readout math by dist_forward (:131-159): tensor_embedding -> interaction
 layers (atom_transfer after each) -> decompose/tensor_norm invariants ->
 out_norm LayerNorm -> linear -> final_layer.gated MLP -> sum.
 
-Per-node state X_i in R^{C x 3 x 3}. All ops are dense (C,3,3) einsums that
-map straight onto the MXU. Distributed contract: edges live with their dst
+Per-node state X_i in R^{3 x 3 x C}, channels LAST: TPU arrays tile their
+trailing two axes to (sublane, lane=128), so keeping C in the lane axis
+(instead of a 3-wide matrix axis padded to 128) cuts the physical footprint
+of every tensor-valued intermediate ~40x. The scalar-gate unflatten keeps
+torchmd-net's (C, 3) order so matgl weights convert unchanged. Distributed
+contract: edges live with their dst
 owner, so every in-edge of an owned node is local; after the embedding and
 each interaction layer the updated tensors of border nodes are refreshed on
 neighbors via ``lg.halo_exchange`` (same cadence as the reference's
@@ -47,18 +51,22 @@ class TensorNetConfig:
 
 
 def decompose(X):
-    """Split (..., 3, 3) into (trace-part I, antisymmetric A, sym-traceless S)."""
-    trace = jnp.trace(X, axis1=-2, axis2=-1)[..., None, None]
-    eye = jnp.eye(3, dtype=X.dtype)
+    """Split (..., 3, 3, C) into (trace-part I, antisymmetric A,
+    sym-traceless S); the matrix lives in axes (-3, -2)."""
+    trace = (X[..., 0, 0, :] + X[..., 1, 1, :] + X[..., 2, 2, :])[
+        ..., None, None, :
+    ]
+    eye = jnp.eye(3, dtype=X.dtype)[:, :, None]
     I = trace / 3.0 * eye
-    A = 0.5 * (X - jnp.swapaxes(X, -1, -2))
-    S = 0.5 * (X + jnp.swapaxes(X, -1, -2)) - I
+    Xt = jnp.swapaxes(X, -3, -2)
+    A = 0.5 * (X - Xt)
+    S = 0.5 * (X + Xt) - I
     return I, A, S
 
 
 def tensor_norm(X):
-    """Per-channel squared Frobenius norm: (..., C, 3, 3) -> (..., C)."""
-    return jnp.sum(X * X, axis=(-2, -1))
+    """Per-channel squared Frobenius norm: (..., 3, 3, C) -> (..., C)."""
+    return jnp.sum(X * X, axis=(-3, -2))
 
 
 def _vector_to_skew(v):
@@ -75,8 +83,9 @@ def _vector_to_skew(v):
 
 def _mix(lin, comp):
     """torchmd-net channel mix: Linear over the channel axis of a
-    (..., C, 3, 3) component (permute -> nn.Linear -> permute)."""
-    return jnp.einsum("...cij,cd->...dij", comp, lin["w"])
+    (..., 3, 3, C) component (torch permutes around nn.Linear; here the
+    channel axis is already last, so it is one lane-resident GEMM)."""
+    return jnp.einsum("...ijc,cd->...ijd", comp, lin["w"])
 
 
 class TensorNet:
@@ -139,9 +148,9 @@ class TensorNet:
         rbf = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_rbf).astype(dtype)
 
         # --- tensor embedding (torchmd-net TensorEmbedding) ---
-        eye = jnp.eye(3, dtype=dtype)
-        A_e = _vector_to_skew(rhat)                              # (E, 3, 3)
-        S_e = rhat[:, :, None] * rhat[:, None, :] - eye / 3.0
+        eye = jnp.eye(3, dtype=dtype)[:, :, None]                # (3, 3, 1)
+        A_e = _vector_to_skew(rhat)[..., None]                   # (E, 3, 3, 1)
+        S_e = (rhat[:, :, None] * rhat[:, None, :])[..., None] - eye / 3.0
 
         z = embedding(params["species_emb"], lg.species)         # (N, C)
         Zij = linear(params["emb2"],
@@ -149,24 +158,24 @@ class TensorNet:
         W1 = linear(params["dist_proj"][0], rbf) * env[:, None]  # (E, C)
         W2 = linear(params["dist_proj"][1], rbf) * env[:, None]
         W3 = linear(params["dist_proj"][2], rbf) * env[:, None]
-        edge_X = Zij[:, :, None, None] * (
-            W1[:, :, None, None] * eye
-            + W2[:, :, None, None] * A_e[:, None]
-            + W3[:, :, None, None] * S_e[:, None]
-        )                                                        # (E, C, 3, 3)
+        edge_X = Zij[:, None, None, :] * (
+            W1[:, None, None, :] * eye
+            + W2[:, None, None, :] * A_e
+            + W3[:, None, None, :] * S_e
+        )                                                        # (E, 3, 3, C)
         X = masked_segment_sum(edge_X, lg.edge_dst, lg.n_cap, lg.edge_mask,
                                indices_are_sorted=True)
 
         norm = layernorm(params["init_norm"], tensor_norm(X))
         for lin in params["emb_lin_scalar"]:
             norm = jax.nn.silu(linear(lin, norm))
-        norm = norm.reshape(-1, C, 3)
+        norm = norm.reshape(-1, C, 3)  # torchmd-net's (C, 3) unflatten order
         I, A, S = decompose(X)
         I = _mix(params["emb_lin_tensor"][0], I)
         A = _mix(params["emb_lin_tensor"][1], A)
         S = _mix(params["emb_lin_tensor"][2], S)
-        X = (I * norm[..., 0, None, None] + A * norm[..., 1, None, None]
-             + S * norm[..., 2, None, None])
+        X = (I * norm[:, None, None, :, 0] + A * norm[:, None, None, :, 1]
+             + S * norm[:, None, None, :, 2])
         X = lg.halo_exchange(X)
 
         # --- interaction layers ---
@@ -192,27 +201,29 @@ class TensorNet:
         f = rbf
         for lin in lp["lin_scalar"]:
             f = jax.nn.silu(linear(lin, f))
-        f = (f * env[:, None]).reshape(-1, C, 3)
+        f = (f * env[:, None]).reshape(-1, C, 3)  # torchmd-net (C, 3) order
 
-        X = X / (tensor_norm(X) + 1.0)[..., None, None]
+        X = X / (tensor_norm(X) + 1.0)[..., None, None, :]
         I, A, S = decompose(X)
         I = _mix(lp["lin_tensor"][0], I)
         A = _mix(lp["lin_tensor"][1], A)
         S = _mix(lp["lin_tensor"][2], S)
         Y = I + A + S
 
-        msg = (f[:, :, 0, None, None] * I[lg.edge_src]
-               + f[:, :, 1, None, None] * A[lg.edge_src]
-               + f[:, :, 2, None, None] * S[lg.edge_src])
+        msg = (f[:, None, None, :, 0] * I[lg.edge_src]
+               + f[:, None, None, :, 1] * A[lg.edge_src]
+               + f[:, None, None, :, 2] * S[lg.edge_src])
         M = masked_segment_sum(msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
                                indices_are_sorted=True)
 
-        B = jnp.einsum("...ij,...jk->...ik", Y, M) \
-            + jnp.einsum("...ij,...jk->...ik", M, Y)
+        # batched 3x3 matmuls over (node, channel); the matrix axes are
+        # (-3, -2), channels ride the lane axis untouched
+        matmul = lambda P, Q: jnp.einsum("nijc,njkc->nikc", P, Q)
+        B = matmul(Y, M) + matmul(M, Y)
         I, A, S = decompose(B)
-        np1 = (tensor_norm(B) + 1.0)[..., None, None]
+        np1 = (tensor_norm(B) + 1.0)[..., None, None, :]
         I = _mix(lp["lin_tensor"][3], I / np1)
         A = _mix(lp["lin_tensor"][4], A / np1)
         S = _mix(lp["lin_tensor"][5], S / np1)
         dX = I + A + S
-        return X + dX + jnp.einsum("...ij,...jk->...ik", dX, dX)
+        return X + dX + matmul(dX, dX)

@@ -37,7 +37,6 @@ def main():
     from distmlip_tpu import geometry
     from distmlip_tpu.calculators import Atoms, DistPotential
     from distmlip_tpu.models import MACE, MACEConfig
-    from distmlip_tpu.models.mace import MACEConfig as _MC
 
     rng = np.random.default_rng(0)
     reps = 16

@@ -8,8 +8,6 @@ per config; run on the real chip.
 Usage: python tools/tune_mace.py [--quick]
 """
 
-import dataclasses
-import itertools
 import json
 import os
 import sys
@@ -50,16 +48,20 @@ def time_config(atoms, rng, *, remat, edge_chunk, node_chunk,
                         compute_stress=compute_stress, skin=0.5,
                         compute_dtype=dtype)
     pos0 = atoms.positions.copy()
-    t0 = time.perf_counter()
-    pot.calculate(atoms)  # compile + first step
-    compile_s = time.perf_counter() - t0
-    times = []
-    for _ in range(steps):
-        atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
+    try:
         t0 = time.perf_counter()
-        pot.calculate(atoms)
-        times.append(time.perf_counter() - t0)
-    atoms.positions[:] = pos0  # keep the skin cache comparable across configs
+        pot.calculate(atoms)  # compile + first step
+        compile_s = time.perf_counter() - t0
+        times = []
+        for _ in range(steps):
+            atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
+            t0 = time.perf_counter()
+            pot.calculate(atoms)
+            times.append(time.perf_counter() - t0)
+    finally:
+        # restore even when a config OOMs/fails to compile: every config
+        # must start from the same positions for comparable timings
+        atoms.positions[:] = pos0
     dt = float(np.median(times))
     return {
         "remat": remat, "edge_chunk": edge_chunk, "node_chunk": node_chunk,

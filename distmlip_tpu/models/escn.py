@@ -36,7 +36,7 @@ import numpy as np
 from ..ops import radial
 from ..ops.nn import cast_params_subtrees, linear, linear_init, mlp, mlp_init
 from ..ops.segment import masked_segment_sum
-from ..ops.so3 import rotation_to_z, spherical_harmonics_stack, wigner_d_batch
+from ..ops.so3 import rotation_to_z, wigner_d_batch
 
 
 @dataclass(frozen=True)
@@ -58,6 +58,14 @@ class ESCNConfig:
     num_datasets: int = 4
     edge_channels: int = 32     # source/target species embeddings feeding the
                                 # edge-degree embedding (ref escn_md.py:378-415)
+    edge_chunk: int = 32768     # process edges in chunks of this size inside a
+                                # lax.scan: the per-edge rotated features
+                                # (E, S, C) and Wigner blocks (E, S, S) are
+                                # rebuilt per chunk, bounding memory regardless
+                                # of system size (0 disables chunking). At
+                                # UMA-real l_max=6, S=49: unchunked 1M-edge
+                                # systems would need >100 GB for these alone.
+    remat: bool = True          # rematerialize each chunk in the backward pass
     dtype: str = "float32"
 
     @property
@@ -180,18 +188,17 @@ class ESCN:
 
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
+        # rhat stays in the positions dtype: the Wigner CG recursion chains
+        # l_max einsums off rotation_to_z(rhat), which compounds bf16 error
+        # to percent level — D is built fp32 and downcast per-use in rotate()
         rhat = vec / jnp.maximum(d, 1e-9)[:, None]
         env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
         bessel = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel
                                                ).astype(dtype)
-
-        # edge-frame Wigner matrices, block-diagonal over l, as one (E,S,S)
-        R_edge = rotation_to_z(rhat)
-        D = wigner_d_batch(cfg.l_max, R_edge)
         sl = _l_slices(cfg.l_max)
 
-        def rotate(hvecs, transpose=False):
-            # hvecs: (E, S, C) in source frame -> rotated per l block
+        def rotate(hvecs, D, transpose=False):
+            # hvecs: (E_c, S, C) in source frame -> rotated per l block
             parts = []
             for l in range(cfg.l_max + 1):
                 Dl = D[l].astype(hvecs.dtype)
@@ -199,6 +206,57 @@ class ESCN:
                     Dl = jnp.swapaxes(Dl, -1, -2)
                 parts.append(jnp.einsum("epq,eqc->epc", Dl, hvecs[:, sl[l], :]))
             return jnp.concatenate(parts, axis=1)
+
+        # --- edge-chunked scan over the per-edge pipeline ---------------
+        # The edge-frame Wigner blocks (E, S, S) and rotated features
+        # (E, S, C) are the memory giants of eSCN; both are rebuilt per
+        # chunk inside a lax.scan (the CG recursion is a few kFLOP/edge —
+        # noise next to the SO(2) GEMMs), so peak memory is O(chunk), not
+        # O(E). Scaffolding shared with MACE (ops/chunk.py).
+        from ..ops.chunk import (chunk_spec, chunked, pad_index, pad_rows,
+                                 scan_accumulate)
+
+        e_cap = lg.edge_src.shape[0]
+        K, chunk, pad = chunk_spec(e_cap, cfg.edge_chunk)
+        edge_xs = (
+            chunked(pad_index(lg.edge_src, pad), K, chunk),
+            chunked(pad_index(lg.edge_dst, pad), K, chunk),
+            chunked(pad_rows(lg.edge_mask, pad), K, chunk),
+            chunked(pad_rows(rhat, pad), K, chunk),
+            chunked(pad_rows(bessel, pad), K, chunk),
+            chunked(pad_rows(env, pad), K, chunk),
+        )
+        # single-chunk path: build D once (fp32) and share it across the
+        # edge-degree pass and every layer instead of per edge_scan call
+        D_shared = (
+            wigner_d_batch(cfg.l_max, rotation_to_z(edge_xs[3][0]))
+            if K == 1 else None
+        )
+
+        def edge_scan(per_chunk, out_shape):
+            """Accumulate sum_chunks per_chunk(...) over the edge chunks.
+
+            per_chunk(srcc, dstc, maskc, D, besc, envc) -> (E_c, ...) message
+            rows, segment-summed onto their dst inside the scan."""
+
+            def body(acc, xs):
+                srcc, dstc, maskc, rhatc, besc, envc = xs
+                D = (
+                    D_shared
+                    if D_shared is not None
+                    else wigner_d_batch(cfg.l_max, rotation_to_z(rhatc))
+                )
+                msg = per_chunk(srcc, dstc, maskc, D, besc, envc)
+                return (
+                    acc
+                    + masked_segment_sum(
+                        msg, dstc, lg.n_cap, maskc, indices_are_sorted=True
+                    ),
+                    None,
+                )
+
+            acc0 = jnp.zeros((lg.n_cap,) + out_shape, dtype=dtype)
+            return scan_accumulate(body, acc0, edge_xs, remat=cfg.remat)
 
         z = lg.species
         zemb = params["species_emb"]["w"][z].astype(dtype)  # (N, C)
@@ -231,24 +289,27 @@ class ESCN:
         # source/target species embeddings) -> m=0 coefficients in the edge
         # frame, rotated back and degree-summed onto the receiver
         # (ref escn_md.py:378-415)
-        x_edge = jnp.concatenate(
-            [
-                bessel,
-                params["source_emb"]["w"][z[lg.edge_src]].astype(dtype),
-                params["target_emb"]["w"][z[lg.edge_dst]].astype(dtype),
-            ],
-            axis=-1,
+        def deg_chunk(srcc, dstc, maskc, D, besc, envc):
+            x_edge = jnp.concatenate(
+                [
+                    besc,
+                    params["source_emb"]["w"][z[srcc]].astype(dtype),
+                    params["target_emb"]["w"][z[dstc]].astype(dtype),
+                ],
+                axis=-1,
+            )
+            w_deg = linear(params["edge_deg"], x_edge).reshape(
+                -1, cfg.l_max + 1, C
+            )
+            y_deg = jnp.zeros((w_deg.shape[0], S, C), dtype=dtype)
+            for l in range(cfg.l_max + 1):
+                y_deg = y_deg.at[:, l * l + _sh_local(l, 0), :].set(
+                    w_deg[:, l, :])  # (l, m=0)
+            return rotate(y_deg, D, transpose=True) * envc[:, None, None]
+
+        h = h + edge_scan(deg_chunk, (S, C)) * jnp.asarray(
+            1.0 / cfg.avg_num_neighbors, dtype=dtype
         )
-        w_deg = linear(params["edge_deg"], x_edge).reshape(-1, cfg.l_max + 1, C)
-        y_deg = jnp.zeros((w_deg.shape[0], S, C), dtype=dtype)
-        for l in range(cfg.l_max + 1):
-            y_deg = y_deg.at[:, l * l + _sh_local(l, 0), :].set(
-                w_deg[:, l, :])  # (l, m=0)
-        deg_msg = rotate(y_deg, transpose=True) * env[:, None, None]
-        h = h + masked_segment_sum(
-            deg_msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
-            indices_are_sorted=True,
-        ) * jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)
         h = lg.halo_exchange(h)
 
         # MOLE coefficients: whole-system composition embedding + csd ->
@@ -268,38 +329,42 @@ class ESCN:
 
         inv_avg = jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)
         for layer in params["layers"]:
-            # edge conditioning scalars
-            ef = jnp.concatenate([bessel, zemb[lg.edge_src], zemb[lg.edge_dst]], axis=-1)
-            g_e = mlp(layer["edge_mlp"], ef) * env[:, None]  # (E, C)
 
-            h_rot = rotate(h[lg.edge_src])  # (E, S, C)
-            # inject edge scalars into the l=0 channel (distance/species info)
-            h_rot = h_rot.at[:, 0, :].add(g_e)
+            def so2_chunk(srcc, dstc, maskc, D, besc, envc, layer=layer):
+                # edge conditioning scalars
+                ef = jnp.concatenate(
+                    [besc, zemb[srcc], zemb[dstc]], axis=-1
+                )
+                g_e = mlp(layer["edge_mlp"], ef) * envc[:, None]  # (E_c, C)
 
-            # SO(2) convolutions per |m|; the per-m feature vector flattens
-            # (nl, C) row-major — the (d, d) weight basis follows this order
-            y = jnp.zeros_like(h_rot)
-            for m in range(cfg.l_max + 1):
-                plus, minus = self.m_idx[m]
-                nl = len(plus)
-                if m == 0:
-                    W = jnp.einsum("k,kab->ab", mole, layer["so2"]["m0"])
-                    f = h_rot[:, plus, :].reshape(-1, nl * C)
-                    y = y.at[:, plus, :].set((f @ W).reshape(-1, nl, C))
-                else:
-                    Wr = jnp.einsum("k,kab->ab", mole, layer["so2"][f"m{m}r"])
-                    Wi = jnp.einsum("k,kab->ab", mole, layer["so2"][f"m{m}i"])
-                    fp = h_rot[:, plus, :].reshape(-1, nl * C)
-                    fm = h_rot[:, minus, :].reshape(-1, nl * C)
-                    yp = fp @ Wr - fm @ Wi
-                    ym = fp @ Wi + fm @ Wr
-                    y = y.at[:, plus, :].set(yp.reshape(-1, nl, C))
-                    y = y.at[:, minus, :].set(ym.reshape(-1, nl, C))
+                h_rot = rotate(h[srcc], D)  # (E_c, S, C)
+                # inject edge scalars into the l=0 channel
+                h_rot = h_rot.at[:, 0, :].add(g_e)
 
-            msg = rotate(y, transpose=True) * env[:, None, None]
-            agg = masked_segment_sum(msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
-                                     indices_are_sorted=True)
-            agg = agg * inv_avg
+                # SO(2) convolutions per |m|; the per-m feature vector
+                # flattens (nl, C) row-major — the (d, d) weight basis
+                # follows this order
+                y = jnp.zeros_like(h_rot)
+                for m in range(cfg.l_max + 1):
+                    plus, minus = self.m_idx[m]
+                    nl = len(plus)
+                    if m == 0:
+                        W = jnp.einsum("k,kab->ab", mole, layer["so2"]["m0"])
+                        f = h_rot[:, plus, :].reshape(-1, nl * C)
+                        y = y.at[:, plus, :].set((f @ W).reshape(-1, nl, C))
+                    else:
+                        Wr = jnp.einsum("k,kab->ab", mole, layer["so2"][f"m{m}r"])
+                        Wi = jnp.einsum("k,kab->ab", mole, layer["so2"][f"m{m}i"])
+                        fp = h_rot[:, plus, :].reshape(-1, nl * C)
+                        fm = h_rot[:, minus, :].reshape(-1, nl * C)
+                        yp = fp @ Wr - fm @ Wi
+                        ym = fp @ Wi + fm @ Wr
+                        y = y.at[:, plus, :].set(yp.reshape(-1, nl, C))
+                        y = y.at[:, minus, :].set(ym.reshape(-1, nl, C))
+
+                return rotate(y, D, transpose=True) * envc[:, None, None]
+
+            agg = edge_scan(so2_chunk, (S, C)) * inv_avg
 
             # gated nonlinearity: scalars via MLP, higher l scaled by gates
             s = agg[:, 0, :]

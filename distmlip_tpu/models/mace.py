@@ -438,30 +438,16 @@ class MACE:
         # density projection A, accumulated over edge chunks (memory-bounded):
         # per chunk, outer(h_src, Y) -> one GEMM over every CG path -> radial
         # weight -> ONE sorted segment sum carrying all Q path components
+        from ..ops.chunk import (chunk_spec, chunked, pad_index, pad_rows,
+                                 scan_accumulate)
+
         e_cap = lg.edge_src.shape[0]
-        chunk = cfg.edge_chunk if cfg.edge_chunk > 0 else e_cap
-        chunk = min(chunk, e_cap)
-        K = -(-e_cap // chunk)
-        pad = K * chunk - e_cap
-
-        def pad_c(x, fill=0):
-            if pad == 0:
-                return x
-            widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-            return jnp.pad(x, widths, constant_values=fill)
-
-        def pad_edge(x):
-            # pad with the last element: dst stays sorted for the
-            # indices_are_sorted segment-sum fast path (padding is masked)
-            if pad == 0:
-                return x
-            return jnp.concatenate([x, jnp.broadcast_to(x[-1], (pad,))])
-
-        src_ch = pad_edge(lg.edge_src).reshape(K, chunk)
-        dst_ch = pad_edge(lg.edge_dst).reshape(K, chunk)
-        mask_ch = pad_c(lg.edge_mask).reshape(K, chunk)
-        bes_ch = pad_c(bessel).reshape(K, chunk, -1)
-        Y_ch = pad_c(Y_full).reshape(K, chunk, -1)
+        K, chunk, pad = chunk_spec(e_cap, cfg.edge_chunk)
+        src_ch = chunked(pad_index(lg.edge_src, pad), K, chunk)
+        dst_ch = chunked(pad_index(lg.edge_dst, pad), K, chunk)
+        mask_ch = chunked(pad_rows(lg.edge_mask, pad), K, chunk)
+        bes_ch = chunked(pad_rows(bessel, pad), K, chunk)
+        Y_ch = chunked(pad_rows(Y_full, pad), K, chunk)
 
         def chunk_body(A_acc, xs):
             srcc, dstc, maskc, Yc, besc = xs
@@ -482,15 +468,10 @@ class MACE:
             )
 
         A0 = jnp.zeros((n_nodes, nQ, C), dtype=dtype)
-        if K == 1:
-            A_all, _ = chunk_body(
-                A0, (src_ch[0], dst_ch[0], mask_ch[0], Y_ch[0], bes_ch[0])
-            )
-        else:
-            body = jax.checkpoint(chunk_body) if cfg.remat else chunk_body
-            A_all, _ = jax.lax.scan(
-                body, A0, (src_ch, dst_ch, mask_ch, Y_ch, bes_ch)
-            )
+        A_all = scan_accumulate(
+            chunk_body, A0, (src_ch, dst_ch, mask_ch, Y_ch, bes_ch),
+            remat=cfg.remat,
+        )
         # per-path output mixing on nodes (upstream's post-conv_tp linear):
         # A[l] = sum_paths A_all[:, :, cols(path)] @ W_path — (P_l*C) GEMMs
         inv_avg = jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)

@@ -1,0 +1,59 @@
+"""Edge-chunked scan scaffolding shared by the model zoo.
+
+Models bound per-edge memory by scanning over fixed-size edge chunks
+(MACE's density projection, eSCN's rotate/SO(2) pipeline). The padding
+contract here matches ops/segment.py: padded index rows repeat the LAST
+real value so dst stays nondecreasing for the ``indices_are_sorted``
+segment-sum fast path (padding is masked), and padded data rows are
+zero-filled.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def chunk_spec(e_cap: int, chunk: int):
+    """(n_chunks K, chunk size, pad rows) for scanning ``e_cap`` edges in
+    chunks of ``chunk`` (``chunk <= 0`` disables chunking: one chunk)."""
+    chunk = e_cap if chunk <= 0 else min(chunk, e_cap)
+    K = -(-e_cap // chunk)
+    return K, chunk, K * chunk - e_cap
+
+
+def pad_rows(x, pad: int, fill=0):
+    """Pad ``pad`` rows of ``fill`` onto axis 0."""
+    if pad == 0:
+        return x
+    widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def pad_index(x, pad: int):
+    """Pad axis 0 by repeating the last element (keeps sorted indices
+    sorted and eager gathers in-bounds; padded rows must be masked)."""
+    if pad == 0:
+        return x
+    return jnp.concatenate([x, jnp.broadcast_to(x[-1], (pad,))])
+
+
+def chunked(x, K: int, chunk: int):
+    """(K*chunk, ...) -> (K, chunk, ...) for lax.scan."""
+    return x.reshape((K, chunk) + x.shape[1:])
+
+
+def scan_accumulate(body, acc0, xs, *, remat: bool):
+    """Sum ``body`` over chunks: ``body(acc, xs_i) -> (acc', None)``.
+
+    K == 1 runs the body once without a scan (and without remat — there
+    is nothing to rematerialize per-chunk); otherwise a lax.scan with the
+    body optionally checkpointed for the backward pass.
+    """
+    K = jax.tree.leaves(xs)[0].shape[0]
+    if K == 1:
+        acc, _ = body(acc0, jax.tree.map(lambda x: x[0], xs))
+        return acc
+    b = jax.checkpoint(body) if remat else body
+    acc, _ = jax.lax.scan(b, acc0, xs)
+    return acc

@@ -224,3 +224,21 @@ def test_lmax6_rotation_invariance_and_fd(rng, params6):
                                        atol=1e-8)
     finally:
         jax.config.update("jax_enable_x64", False)
+
+
+def test_edge_chunking_matches_unchunked(rng, params):
+    """K>1 edge-chunked scan (with remat) must reproduce the unchunked
+    pipeline exactly — the chunk boundary must not leak into Wigner
+    rebuilds, SO(2) convs, or the sorted segment sums."""
+    import dataclasses
+
+    cart, lattice, species = make_crystal(rng, reps=(3, 3, 3))
+    m_un = ESCN(dataclasses.replace(CFG, edge_chunk=0))
+    m_ch = ESCN(dataclasses.replace(CFG, edge_chunk=64))  # forces K >> 1
+    e0, f0, s0 = run_potential(m_un.energy_fn, params, cart, lattice, species,
+                               CFG.cutoff, 1)
+    e1, f1, s1 = run_potential(m_ch.energy_fn, params, cart, lattice, species,
+                               CFG.cutoff, 1)
+    assert abs(e0 - e1) < 1e-5 * max(1.0, abs(e0))
+    np.testing.assert_allclose(f0, f1, atol=1e-5)
+    np.testing.assert_allclose(s0, s1, atol=1e-7)

@@ -119,15 +119,17 @@ def _message_paths(h_ls, l_max, out_ls):
 
 
 def _projection_tables(h_ls, l_max, paths):
-    """MXU-shaped density projection: fold ALL (l_h, l_Y, l_out) CG couplings
-    into one dense block matrix so the per-edge work is a single GEMM.
+    """Density projection tables: fold ALL (l_h, l_Y, l_out) CG couplings
+    into one dense block matrix.
 
         W[(l_h m) * S_Y + (l_Y n), q(path, p)] = CG^{l_h l_Y l_out}[m, n, p]
 
-    Per edge: outer(h_src, Y) (E, S_h*S_Y, C) contracted with W (S_h*S_Y, Q)
-    along the S_h*S_Y axis — one matmul
-    covering every path, instead of the per-path ``ecm,en,mnp->ecp`` einsums
-    that lowered to gather/VPU work (round-1 bottleneck, ROADMAP lever 1).
+    Per edge chunk the contraction is factored through the channel-free
+    intermediate T[e, m, q] = sum_n Y[e, n] W[(m, n), q] (tiny), then
+    M[e, q, c] = sum_m T[e, m, q] h_src[e, m, c] — S_h fused multiply-adds
+    per output element, with no (E, S_h*S_Y, C) outer product materialized
+    (replaces the per-path ``ecm,en,mnp->ecp`` einsums of round 1 and the
+    outer-product GEMM of round 2).
 
     Returns dict with: W (K, Q) float64, q_path (Q,) path index per column,
     h_off {l: row-block offset}, S_h, S_Y, and lo_cols {l_out: (P_l, 2l+1)}
@@ -449,15 +451,18 @@ class MACE:
         bes_ch = chunked(pad_rows(bessel, pad), K, chunk)
         Y_ch = chunked(pad_rows(Y_full, pad), K, chunk)
 
+        Wp3 = Wp.reshape(proj["S_h"], proj["S_Y"], nQ)
+
         def chunk_body(A_acc, xs):
             srcc, dstc, maskc, Yc, besc = xs
             Rc = mlp(inter["radial"], besc).reshape(chunk, len(paths), C)
-            # outer[e, m, n, c] = h_src[e, m, c] * Y[e, n]: trailing axes
-            # (S_Y, C) tile the (sublane, lane) grid exactly
-            outer = hu[srcc][:, :, None, :] * Yc[:, None, :, None]
-            M = jnp.einsum(                               # (E_c, Q, C) [MXU]
-                "ekc,kq->eqc", outer.reshape(chunk, -1, C), Wp
-            )
+            # factor the CG contraction: T[e,m,q] = sum_n Y[e,n] W[(m,n),q]
+            # is channel-free and tiny (E_c, S_h, Q); contracting it with
+            # h_src over m (<= S_h) then costs S_h fused multiply-adds per
+            # (q, c) — no (E_c, S_h*S_Y, C) outer product ever materializes
+            # (the outer was ~0.5 GB/chunk and 16x the FLOPs)
+            T = jnp.einsum("en,mnq->emq", Yc, Wp3)
+            M = jnp.einsum("emq,emc->eqc", T, hu[srcc])   # (E_c, Q, C)
             M = M * Rc[:, q_path, :]                      # per-path radial
             return (
                 A_acc

@@ -17,6 +17,10 @@ import jax.numpy as jnp
 def chunk_spec(e_cap: int, chunk: int):
     """(n_chunks K, chunk size, pad rows) for scanning ``e_cap`` edges in
     chunks of ``chunk`` (``chunk <= 0`` disables chunking: one chunk)."""
+    if e_cap == 0:
+        # edgeless graph (single atom / nothing within cutoff): one empty
+        # chunk; the body sees (0, ...) arrays and the segment sums yield 0
+        return 1, 0, 0
     chunk = e_cap if chunk <= 0 else min(chunk, e_cap)
     K = -(-e_cap // chunk)
     return K, chunk, K * chunk - e_cap
@@ -46,14 +50,15 @@ def chunked(x, K: int, chunk: int):
 def scan_accumulate(body, acc0, xs, *, remat: bool):
     """Sum ``body`` over chunks: ``body(acc, xs_i) -> (acc', None)``.
 
-    K == 1 runs the body once without a scan (and without remat — there
-    is nothing to rematerialize per-chunk); otherwise a lax.scan with the
-    body optionally checkpointed for the backward pass.
+    The body is checkpointed whenever ``remat`` — including for K == 1, so
+    a system just under one chunk keeps the same bounded backward memory
+    as one just over (the single chunk's per-edge intermediates are the
+    largest residuals there).
     """
+    b = jax.checkpoint(body) if remat else body
     K = jax.tree.leaves(xs)[0].shape[0]
     if K == 1:
-        acc, _ = body(acc0, jax.tree.map(lambda x: x[0], xs))
+        acc, _ = b(acc0, jax.tree.map(lambda x: x[0], xs))
         return acc
-    b = jax.checkpoint(body) if remat else body
     acc, _ = jax.lax.scan(b, acc0, xs)
     return acc

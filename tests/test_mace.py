@@ -186,3 +186,22 @@ def test_multihead_readout(rng):
     e0b, _, _ = run_potential(model.energy_fn, p2, cart, lattice, species,
                               cfg.cutoff, 1, compute_stress=False)
     assert abs(e0 - e0b) < 1e-6
+
+
+def test_edge_node_chunking_matches_unchunked(rng, params):
+    """K>1 edge-chunked density projection AND node-chunked symmetric
+    contraction (remat scan paths) must reproduce the unchunked forward
+    exactly — guards the per-chunk padding, the T-factorized projection,
+    and the scan accumulation."""
+    import dataclasses
+
+    cart, lattice, species = make_crystal(rng, reps=(3, 3, 3))
+    m_un = MACE(dataclasses.replace(CFG, edge_chunk=0, node_chunk=0))
+    m_ch = MACE(dataclasses.replace(CFG, edge_chunk=96, node_chunk=17))
+    e0, f0, s0 = run_potential(m_un.energy_fn, params, cart, lattice, species,
+                               CFG.cutoff, 1)
+    e1, f1, s1 = run_potential(m_ch.energy_fn, params, cart, lattice, species,
+                               CFG.cutoff, 1)
+    assert abs(e0 - e1) < 1e-5 * max(1.0, abs(e0))
+    np.testing.assert_allclose(f0, f1, atol=1e-5)
+    np.testing.assert_allclose(s0, s1, atol=1e-7)

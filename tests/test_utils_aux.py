@@ -89,3 +89,15 @@ def test_checkpoint_escn_roundtrip(tmp_path):
     restored = load_params(path, like=params)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_chunk_spec_edge_cases():
+    """chunk_spec: disabled chunking, exact division, remainder, and the
+    edgeless-graph guard (e_cap=0 must not divide by zero)."""
+    from distmlip_tpu.ops.chunk import chunk_spec
+
+    assert chunk_spec(100, 0) == (1, 100, 0)       # disabled -> one chunk
+    assert chunk_spec(100, 25) == (4, 25, 0)       # exact
+    assert chunk_spec(100, 30) == (4, 30, 20)      # remainder padded
+    assert chunk_spec(10, 1000) == (1, 10, 0)      # chunk > e_cap clamps
+    assert chunk_spec(0, 32768) == (1, 0, 0)       # edgeless graph

@@ -5,7 +5,12 @@ partition. On a machine without 4 real chips this runs on a virtual
 8-device CPU mesh (slow but exact). Round-2 result (2026-07-29, CPU mesh):
 48,668 atoms — 4-way == 1-way to 2.5e-9 eV/atom, dF_max 9.9e-8 eV/Å.
 
-Run: python examples/05_scale_ladder.py [--config 2]
+Run: python examples/05_scale_ladder.py [--config 2|3|4]
+  2: TensorNet ~49k atoms, 4-way    3: MACE ~192k atoms, 8-way
+  4: eSCN/UMA ~101k atoms, 8-way (csd + MOLE + chunked Wigner/SO(2))
+Set DISTMLIP_REAL_DEVICES=1 to run configs 3/4 single-chip on real
+hardware (bf16, production model shapes) instead of the CPU-mesh
+correctness compare.
 """
 
 import os
@@ -27,6 +32,22 @@ from distmlip_tpu.calculators import Atoms, DistPotential
 from distmlip_tpu.models import TensorNet, TensorNetConfig
 
 
+def compare_partitions(tag, model, params, atoms, smap, P, tol_de, tol_df):
+    """P-way vs 1-way energy/forces compare — the ladder's shared check."""
+    results = {}
+    for n in (P, 1):
+        t0 = time.time()
+        pot = DistPotential(model, params, num_partitions=n, species_map=smap)
+        results[n] = pot.calculate(atoms)
+        print(f"{n}-way: E={results[n]['energy']:.4f} "
+              f"({time.time() - t0:.0f}s incl compile)")
+    de = abs(results[P]["energy"] - results[1]["energy"]) / len(atoms)
+    df = np.abs(results[P]["forces"] - results[1]["forces"]).max()
+    print(f"{P}-way vs 1-way: dE/atom={de:.2e} eV  dF_max={df:.2e} eV/Å")
+    assert de < tol_de and df < tol_df
+    print(f"CONFIG {tag} PASSED")
+
+
 def config2():
     cfg = TensorNetConfig(num_species=16, units=64, num_rbf=8, num_layers=2,
                           cutoff=5.0)
@@ -43,19 +64,7 @@ def config2():
                   cell=lattice)
     smap = np.concatenate([[0], np.arange(0, 16)]).astype(np.int32)
     print(f"config 2: TensorNet, n_atoms = {len(atoms)}")
-
-    results = {}
-    for P in (4, 1):
-        t0 = time.time()
-        pot = DistPotential(model, params, num_partitions=P, species_map=smap)
-        results[P] = pot.calculate(atoms)
-        print(f"{P}-way: E={results[P]['energy']:.4f} "
-              f"({time.time() - t0:.0f}s incl compile)")
-    de = abs(results[4]["energy"] - results[1]["energy"]) / len(atoms)
-    df = np.abs(results[4]["forces"] - results[1]["forces"]).max()
-    print(f"4-way vs 1-way: dE/atom={de:.2e} eV  dF_max={df:.2e} eV/Å")
-    assert de < 1e-6 and df < 5e-4
-    print("CONFIG 2 PASSED")
+    compare_partitions(2, model, params, atoms, smap, 4, 1e-6, 5e-4)
 
 
 def config3():
@@ -115,18 +124,54 @@ def config3():
                      avg_num_neighbors=40.0)
     model = MACE(cfg)
     params = model.init(jax.random.PRNGKey(0))
-    results = {}
-    for P in (8, 1):
-        t0 = time.time()
-        pot = DistPotential(model, params, num_partitions=P, species_map=smap)
-        results[P] = pot.calculate(atoms)
-        print(f"{P}-way: E={results[P]['energy']:.4f} "
-              f"({time.time() - t0:.0f}s incl compile)")
-    de = abs(results[8]["energy"] - results[1]["energy"]) / len(atoms)
-    df = np.abs(results[8]["forces"] - results[1]["forces"]).max()
-    print(f"8-way vs 1-way: dE/atom={de:.2e} eV  dF_max={df:.2e} eV/Å")
-    assert de < 1e-5 and df < 1e-3
-    print("CONFIG 3 PASSED")
+    compare_partitions(3, model, params, atoms, smap, 8, 1e-5, 1e-3)
+
+
+def config4():
+    """UMA/eSCN, ~100k-atom slab-like box, 8-way partition (BASELINE.md
+    config 4's family at CPU-mesh-tractable size).
+
+    Exercises the UMA-specific machinery at scale: csd conditioning, MOLE
+    expert gating (psum-consistent across partitions), the edge-degree
+    embedding, and the edge-chunked Wigner/SO(2) scan (ops/chunk.py) that
+    bounds per-edge memory — at this size the unchunked rotated features
+    alone would be ~37 GB. With DISTMLIP_REAL_DEVICES=1 a single real chip
+    runs the same system in bfloat16 at l_max=4.
+    """
+    from distmlip_tpu.models import ESCN, ESCNConfig
+
+    real = bool(os.environ.get("DISTMLIP_REAL_DEVICES"))
+    rng = np.random.default_rng(0)
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 4.2, (30, 30, 28))
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, 0.06, (len(frac), 3))
+    atoms = Atoms(numbers=rng.integers(1, 9, len(cart)), positions=cart,
+                  cell=lattice)
+    atoms.info = {"charge": 1, "spin": 1, "dataset": 2}
+    smap = np.concatenate([[0], np.arange(0, 8)]).astype(np.int32)
+    print(f"config 4: eSCN/UMA, n_atoms = {len(atoms)} "
+          f"({'bf16 l_max=4, real devices' if real else 'l_max=2, CPU mesh'})")
+
+    if real:
+        cfg = ESCNConfig(num_species=8, channels=128, l_max=4, num_layers=2,
+                         num_experts=8, cutoff=5.0, avg_num_neighbors=40.0,
+                         dtype="bfloat16")
+        model = ESCN(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        pot = DistPotential(model, params, num_partitions=1, species_map=smap)
+        for tag in ("cold", "warm", "warm"):
+            t0 = time.time()
+            pot.calculate(atoms)
+            print(f"single-chip {tag}: {time.time() - t0:.2f}s "
+                  f"({len(atoms) / (time.time() - t0):.0f} atoms/s)")
+        return
+
+    cfg = ESCNConfig(num_species=8, channels=32, l_max=2, num_layers=2,
+                     num_experts=4, cutoff=4.0, avg_num_neighbors=30.0)
+    model = ESCN(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    compare_partitions(4, model, params, atoms, smap, 8, 1e-5, 1e-3)
 
 
 if __name__ == "__main__":
@@ -135,4 +180,4 @@ if __name__ == "__main__":
     which = "2"
     if "--config" in sys.argv:
         which = sys.argv[sys.argv.index("--config") + 1]
-    {"2": config2, "3": config3}[which]()
+    {"2": config2, "3": config3, "4": config4}[which]()

@@ -1,0 +1,83 @@
+"""Capture a jax.profiler trace of one bench MD step and print the top ops.
+
+Runs the exact bench system, traces 2 steady-state steps, then parses the
+xplane proto (tensorboard_plugin_profile) into per-op device-time totals so
+the hot spots are named (fusion/scatter/gather/dot) without a TensorBoard
+UI. One JSON line per top op.
+
+Usage: python tools/trace_mace.py [outdir]
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def top_ops_from_xplane(logdir, n=25):
+    from tensorboard_plugin_profile.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        return None
+    xs = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    totals = {}
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "Device" not in plane.name:
+            continue
+        ev_meta = {m.id: m.name for m in plane.event_metadata.values()}
+        for line in plane.lines:
+            for ev in line.events:
+                name = ev_meta.get(ev.metadata_id, str(ev.metadata_id))
+                totals[name] = totals.get(name, 0.0) + ev.duration_ps / 1e9
+    return sorted(totals.items(), key=lambda kv: -kv[1])[:n]
+
+
+def main():
+    import jax
+
+    from distmlip_tpu import geometry
+    from distmlip_tpu.calculators import Atoms, DistPotential
+    from distmlip_tpu.models import MACE, MACEConfig
+
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mace_trace"
+    rng = np.random.default_rng(0)
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9, (16, 16, 16))
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, 0.04, (len(frac), 3))
+    atoms = Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
+
+    cfg = MACEConfig(num_species=95, channels=128, l_max=3, a_lmax=3,
+                     hidden_lmax=1, correlation=3, num_interactions=2,
+                     num_bessel=8, radial_mlp=64, cutoff=5.0,
+                     avg_num_neighbors=14.0)
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    pot = DistPotential(model, params, num_partitions=1, compute_stress=True,
+                        skin=0.5, compute_dtype="bfloat16")
+    pot.calculate(atoms)  # compile + warm
+
+    with jax.profiler.trace(outdir):
+        for _ in range(2):
+            atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
+            pot.calculate(atoms)
+
+    tops = top_ops_from_xplane(outdir)
+    if tops is None:
+        print(json.dumps({"error": f"no xplane.pb under {outdir}"}))
+        return
+    total = sum(ms for _, ms in tops)
+    for name, ms in tops:
+        print(json.dumps({"op": name[:120], "ms": round(ms, 2),
+                          "pct_of_top": round(100 * ms / total, 1)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

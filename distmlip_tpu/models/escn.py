@@ -258,7 +258,9 @@ class ESCN:
             acc0 = jnp.zeros((lg.n_cap,) + out_shape, dtype=dtype)
             return scan_accumulate(body, acc0, edge_xs, remat=cfg.remat)
 
-        z = lg.species
+        # device array: the chunked scan indexes z with traced chunk indices,
+        # which a host numpy species array cannot support
+        z = jnp.asarray(lg.species)
         zemb = params["species_emb"]["w"][z].astype(dtype)  # (N, C)
 
         # csd (charge/spin/dataset) system embedding (ref escn_md.py:255-265)

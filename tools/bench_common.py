@@ -1,0 +1,35 @@
+"""Shared bench workload: the exact system + model config bench.py times.
+
+Every attribution/tuning tool must measure THIS workload, or its numbers
+describe a different program than the recorded benchmark.
+"""
+
+import numpy as np
+
+
+def build_bench_atoms(reps=16, seed=0):
+    """bench.py's 4*reps^3-atom perturbed Si-like crystal (16 -> 16384)."""
+    from distmlip_tpu import geometry
+    from distmlip_tpu.calculators import Atoms
+
+    rng = np.random.default_rng(seed)
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9,
+                                            (reps, reps, reps))
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, 0.04, (len(frac), 3))
+    return Atoms(numbers=np.full(len(cart), 14), positions=cart,
+                 cell=lattice), rng
+
+
+def bench_mace_config(**overrides):
+    """bench.py's MP-0-faithful MACE shape (PARITY.md: a_lmax=l_max=3)."""
+    from distmlip_tpu.models import MACEConfig
+
+    base = dict(
+        num_species=95, channels=128, l_max=3, a_lmax=3, hidden_lmax=1,
+        correlation=3, num_interactions=2, num_bessel=8, radial_mlp=64,
+        cutoff=5.0, avg_num_neighbors=14.0,
+    )
+    base.update(overrides)
+    return MACEConfig(**base)

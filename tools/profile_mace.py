@@ -14,7 +14,9 @@ from functools import partial
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)  # for bench_common
 
 
 def bench_fn(fn, *args, reps=3):
@@ -34,22 +36,12 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from distmlip_tpu import geometry
-    from distmlip_tpu.calculators import Atoms, DistPotential
-    from distmlip_tpu.models import MACE, MACEConfig
+    from bench_common import bench_mace_config, build_bench_atoms
+    from distmlip_tpu.calculators import DistPotential
+    from distmlip_tpu.models import MACE
 
-    rng = np.random.default_rng(0)
-    reps = 16
-    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
-    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9, (reps, reps, reps))
-    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, 0.04, (len(frac), 3))
-    atoms = Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
-
-    cfg = MACEConfig(
-        num_species=95, channels=128, l_max=3, a_lmax=3, hidden_lmax=1,
-        correlation=3, num_interactions=2, num_bessel=8, radial_mlp=64,
-        cutoff=5.0, avg_num_neighbors=14.0, dtype="bfloat16",
-    )
+    atoms, rng = build_bench_atoms()
+    cfg = bench_mace_config(dtype="bfloat16")
     model = MACE(cfg)
     params = model.init(jax.random.PRNGKey(0))
     pot = DistPotential(model, params, num_partitions=1, compute_stress=True,

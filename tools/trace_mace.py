@@ -15,7 +15,9 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)  # for bench_common
 
 
 def top_ops_from_xplane(logdir, n=25):
@@ -28,36 +30,37 @@ def top_ops_from_xplane(logdir, n=25):
     with open(sorted(paths)[-1], "rb") as f:
         xs.ParseFromString(f.read())
     totals = {}
+    planes_seen = []
     for plane in xs.planes:
-        if "TPU" not in plane.name and "Device" not in plane.name:
+        planes_seen.append(plane.name)
+        name_l = plane.name.lower()
+        if "tpu" not in name_l and "device" not in name_l and "gpu" not in name_l:
             continue
         ev_meta = {m.id: m.name for m in plane.event_metadata.values()}
         for line in plane.lines:
+            # only the per-op line: module/step-level lines ("XLA Modules",
+            # "Steps") each hold one event spanning the whole jitted step,
+            # which would rank as a fake top op and double the denominator
+            if "op" not in line.name.lower():
+                continue
             for ev in line.events:
                 name = ev_meta.get(ev.metadata_id, str(ev.metadata_id))
                 totals[name] = totals.get(name, 0.0) + ev.duration_ps / 1e9
+    if not totals:
+        return {"planes": planes_seen}  # parsed, but nothing op-like matched
     return sorted(totals.items(), key=lambda kv: -kv[1])[:n]
 
 
 def main():
     import jax
 
-    from distmlip_tpu import geometry
-    from distmlip_tpu.calculators import Atoms, DistPotential
-    from distmlip_tpu.models import MACE, MACEConfig
+    from bench_common import bench_mace_config, build_bench_atoms
+    from distmlip_tpu.calculators import DistPotential
+    from distmlip_tpu.models import MACE
 
     outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/mace_trace"
-    rng = np.random.default_rng(0)
-    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
-    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9, (16, 16, 16))
-    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, 0.04, (len(frac), 3))
-    atoms = Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
-
-    cfg = MACEConfig(num_species=95, channels=128, l_max=3, a_lmax=3,
-                     hidden_lmax=1, correlation=3, num_interactions=2,
-                     num_bessel=8, radial_mlp=64, cutoff=5.0,
-                     avg_num_neighbors=14.0)
-    model = MACE(cfg)
+    atoms, rng = build_bench_atoms()
+    model = MACE(bench_mace_config())
     params = model.init(jax.random.PRNGKey(0))
     pot = DistPotential(model, params, num_partitions=1, compute_stress=True,
                         skin=0.5, compute_dtype="bfloat16")
@@ -71,6 +74,10 @@ def main():
     tops = top_ops_from_xplane(outdir)
     if tops is None:
         print(json.dumps({"error": f"no xplane.pb under {outdir}"}))
+        return
+    if isinstance(tops, dict):
+        print(json.dumps({"error": "trace parsed but no per-op device line "
+                                   "matched", **tops}))
         return
     total = sum(ms for _, ms in tops)
     for name, ms in tops:

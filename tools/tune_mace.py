@@ -15,33 +15,21 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def build_atoms(reps=16):
-    from distmlip_tpu import geometry
-    from distmlip_tpu.calculators import Atoms
-
-    rng = np.random.default_rng(0)
-    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
-    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9, (reps, reps, reps))
-    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(0, 0.04, (len(frac), 3))
-    return Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice), rng
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)  # for bench_common
 
 
 def time_config(atoms, rng, *, remat, edge_chunk, node_chunk,
                 compute_stress=True, dtype="bfloat16", steps=5):
     import jax
 
+    from bench_common import bench_mace_config
     from distmlip_tpu.calculators import DistPotential
-    from distmlip_tpu.models import MACE, MACEConfig
+    from distmlip_tpu.models import MACE
 
-    cfg = MACEConfig(
-        num_species=95, channels=128, l_max=3, a_lmax=3, hidden_lmax=1,
-        correlation=3, num_interactions=2, num_bessel=8, radial_mlp=64,
-        cutoff=5.0, avg_num_neighbors=14.0,
-        remat=remat, edge_chunk=edge_chunk, node_chunk=node_chunk,
-    )
+    cfg = bench_mace_config(remat=remat, edge_chunk=edge_chunk,
+                            node_chunk=node_chunk)
     model = MACE(cfg)
     params = model.init(jax.random.PRNGKey(0))
     pot = DistPotential(model, params, num_partitions=len(jax.devices()),
@@ -74,7 +62,7 @@ def time_config(atoms, rng, *, remat, edge_chunk, node_chunk,
 
 def main():
     quick = "--quick" in sys.argv
-    atoms, rng = build_atoms()
+    atoms, rng = build_bench_atoms()
     configs = [
         # (remat, edge_chunk, node_chunk, stress, dtype)
         (True, 32768, 4096, True, "bfloat16"),    # bench default (baseline)

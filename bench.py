@@ -96,30 +96,31 @@ def main():
                         skin=float(os.environ.get("BENCH_SKIN", "0.5")),
                         compute_dtype=bench_dtype)
 
-    # warmup (compile) under a watchdog: a wedged chip grant can pass the
-    # claim (jax.devices() returns) yet hang the first compile/execute
-    # forever (round-3 lesson) — emit structured failure instead of letting
-    # the driver record a bare timeout with no JSON
+    # run the measurement under a watchdog: a wedged chip grant can pass
+    # the claim (jax.devices() returns) yet hang the first compile/execute
+    # — or drop mid-run — forever (round-3 lesson). Emit structured
+    # failure instead of letting the driver record a bare timeout with no
+    # JSON. Deadline: warmup budget + a generous per-step allowance.
     import threading
 
     warm_timeout = float(os.environ.get("BENCH_WARMUP_TIMEOUT_S", "600"))
+    deadline = warm_timeout + 60.0 * steps
     done = threading.Event()
 
     def _watchdog():
-        if not done.wait(warm_timeout):
+        if not done.wait(deadline):
             print(json.dumps({
                 "metric": "mace_mp0_md_step_atoms_per_sec_per_chip",
                 "value": 0.0,
                 "unit": "atoms/s",
                 "vs_baseline": 0.0,
-                "error": f"backend wedged: warmup compile/execute exceeded "
-                         f"{warm_timeout:.0f}s (chip claimed but not serving)",
+                "error": f"backend wedged: compile/execute exceeded "
+                         f"{deadline:.0f}s (chip claimed but not serving)",
             }), flush=True)
             os._exit(3)
 
     threading.Thread(target=_watchdog, daemon=True).start()
     pot.calculate(atoms)
-    done.set()
     # steady state: perturb positions each step like MD
     times = []
     for _ in range(steps):
@@ -127,6 +128,8 @@ def main():
         t0 = time.perf_counter()
         res = pot.calculate(atoms)
         times.append(time.perf_counter() - t0)
+    done.set()  # before printing: a late watchdog firing must not emit a
+    #             second, contradictory JSON line after the success line
     dt = float(np.median(times))
     atoms_per_sec = len(atoms) / dt / max(len(jax.devices()), 1)
 

@@ -1,19 +1,25 @@
 #!/bin/bash
 # Probe the axon TPU backend until it comes up; append status lines to
 # /tmp/tpu_watch.log and write /tmp/tpu_up when a matmul succeeds.
+#
+# Cadence (round-3 lesson): a timeout-KILLED mid-claim probe RENEWS the
+# wedged chip grant, so after a killed probe (rc 124) back off 20 min.
+# A probe that fails fast on its own never touched a kill, so it retries
+# on a 3-min cadence — a recovered chip is seen quickly.
 rm -f /tmp/tpu_up
 while true; do
   ts=$(date +%H:%M:%S)
-  out=$(timeout 240 python -c "
+  out=$(timeout 1200 python -c "
 import jax, jax.numpy as jnp
 d = jax.devices()
 x = jnp.ones((256, 256), jnp.bfloat16)
 print('OK', d[0].platform, d[0].device_kind, float((x @ x).sum()))
 " 2>&1 | tail -1)
-  echo "$ts $out" >> /tmp/tpu_watch.log
+  rc=$?
+  echo "$ts rc=$rc $out" >> /tmp/tpu_watch.log
   if [[ "$out" == OK* ]]; then
     echo "$ts $out" > /tmp/tpu_up
     exit 0
   fi
-  sleep 180
+  if [ "$rc" -eq 124 ]; then sleep 1200; else sleep 180; fi
 done

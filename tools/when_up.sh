@@ -1,0 +1,33 @@
+#!/bin/bash
+# Wait for the TPU watcher's /tmp/tpu_up marker, then run the measurement
+# battery back-to-back (one chip, strictly serial). Results land in
+# /tmp/window/. No process is ever killed mid-claim (see
+# .claude/skills/verify: killing a claiming process wedges the grant).
+# Launch BEFORE (or together with) tools/tpu_watch.sh: the stale marker
+# from a previous window is removed here so an old file cannot fire the
+# battery against a down backend.
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/window
+rm -f /tmp/tpu_up
+while [ ! -f /tmp/tpu_up ]; do sleep 60; done
+echo "$(date +%H:%M:%S) chip is up — starting battery" >> /tmp/window/log
+python bench.py > /tmp/window/bench.json 2> /tmp/window/bench.err
+rc=$?
+echo "$(date +%H:%M:%S) bench done rc=$rc" >> /tmp/window/log
+if [ "$rc" -ne 0 ]; then
+  # rc=3: watchdog fired — chip claimed but not serving. The remaining
+  # tools have no watchdog and would hang unkillably; stop here.
+  echo "$(date +%H:%M:%S) bench failed — skipping trace/tune/profile" \
+    >> /tmp/window/log
+  exit "$rc"
+fi
+python tools/trace_mace.py /tmp/window/trace > /tmp/window/trace_ops.jsonl \
+  2> /tmp/window/trace.err
+rc=$?
+echo "$(date +%H:%M:%S) trace done rc=$rc" >> /tmp/window/log
+python tools/tune_mace.py > /tmp/window/tune.jsonl 2> /tmp/window/tune.err
+rc=$?
+echo "$(date +%H:%M:%S) tune done rc=$rc" >> /tmp/window/log
+python tools/profile_mace.py > /tmp/window/profile.jsonl 2> /tmp/window/profile.err
+rc=$?
+echo "$(date +%H:%M:%S) profile done rc=$rc" >> /tmp/window/log

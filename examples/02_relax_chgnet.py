@@ -1,6 +1,15 @@
 """Structure relaxation (positions + cell) with distributed CHGNet."""
 
+import os
+
 import jax
+
+# default: 8-virtual-device CPU mesh so the example runs anywhere;
+# set DISTMLIP_REAL_DEVICES=1 to use the machine's real accelerators
+if not os.environ.get("DISTMLIP_REAL_DEVICES"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
 import numpy as np
 
 from distmlip_tpu import geometry

@@ -5,7 +5,16 @@ its slab's contribution and parameter gradients are psum'd — capability the
 reference does not have (it is inference-only, README.md:53).
 """
 
+import os
+
 import jax
+
+# default: 8-virtual-device CPU mesh so the example runs anywhere;
+# set DISTMLIP_REAL_DEVICES=1 to use the machine's real accelerators
+if not os.environ.get("DISTMLIP_REAL_DEVICES"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
 import numpy as np
 import optax
 

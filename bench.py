@@ -14,21 +14,126 @@ numbers are not published in-repo, see BASELINE.md).
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
 
+_METRIC = "mace_mp0_md_step_atoms_per_sec_per_chip"
 
-def _claim_backend():
+
+def _result_json(value, vs=0.0, error=None, **extra):
+    out = {
+        "metric": _METRIC,
+        "value": round(float(value), 1),
+        "unit": "atoms/s",
+        "vs_baseline": round(float(vs), 3),
+    }
+    if error:
+        out["error"] = error
+    out.update(extra)
+    return json.dumps(out)
+
+
+def _vs_baseline(atoms_per_sec):
+    base_path = os.path.join(os.path.dirname(__file__), "BASELINE_LOCAL.json")
+    if os.path.exists(base_path):
+        ref = json.load(open(base_path)).get("mace_mp0_md_atoms_per_sec")
+        if ref:
+            return atoms_per_sec / ref
+    return 0.0
+
+
+class _Watchdog:
+    """Deadline watchdog guaranteeing the bench always self-exits with JSON.
+
+    The round-3 failure mode: `jax.devices()` on a wedged axon chip grant
+    neither raises nor returns — it HANGS, defeating the retry loop, so the
+    driver timeout-kills the process with no JSON emitted (BENCH_r03 rc=124,
+    parsed=null) and the SIGKILL of a mid-claim process renews the wedge.
+
+    Two deadlines run at once: a per-phase budget (claim, setup, warmup,
+    each step — re-armed as phases progress, so a hang is caught quickly
+    with a phase-specific message) and a GLOBAL budget from process start
+    (BENCH_TOTAL_TIMEOUT_S, default 1200 s) so a degraded-but-not-hung run
+    that stays under every per-phase budget still self-exits before the
+    driver's kill window (observed > 25 min). Firing and finish() are
+    serialized under one lock, so a success line and a watchdog line can
+    never both be printed. If measured steps completed before the firing,
+    their median is reported as a partial result instead of 0.0.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._deadline = None
+        self._msg = ""
+        self._finished = False
+        total = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", "1200"))
+        self._global_deadline = time.monotonic() + total
+        self._global_msg = f"total run exceeded {total:.0f}s"
+        # main() publishes measurement context here for partial reporting
+        self.times = []
+        self.n_atoms = 0
+        self.n_devices = 1
+        self._stop = threading.Event()
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def phase(self, msg, budget_s):
+        with self._lock:
+            self._msg = msg
+            self._deadline = time.monotonic() + budget_s
+
+    def finish(self):
+        """Atomically disarm: after this returns, the watchdog can no longer
+        print (a firing in progress would have os._exit'd before the lock
+        was released to us)."""
+        with self._lock:
+            self._finished = True
+        self._stop.set()
+
+    def _fire(self, msg):
+        if self.times and self.n_atoms:
+            dt = float(np.median(self.times))
+            aps = self.n_atoms / dt / max(self.n_devices, 1)
+            line = _result_json(
+                aps, _vs_baseline(aps),
+                error=f"watchdog: {msg}; partial result from "
+                      f"{len(self.times)} completed steps",
+                partial=True)
+        else:
+            line = _result_json(0.0, error=f"watchdog: {msg}")
+        print(line, flush=True)
+        sys.stderr.flush()
+        # exit 0 so the artifact parses and the driver never SIGKILLs a
+        # mid-claim process (which re-wedges the chip)
+        os._exit(0)
+
+    def _run(self):
+        while not self._stop.wait(1.0):
+            with self._lock:
+                if self._finished:
+                    return
+                now = time.monotonic()
+                if now > self._global_deadline:
+                    self._fire(self._global_msg)
+                if self._deadline is not None and now > self._deadline:
+                    self._fire(self._msg)
+
+
+def _claim_backend(watchdog):
     """Initialize the JAX backend, retrying transient claim failures.
 
     The axon TPU tunnel can refuse a claim transiently; a bare traceback
     here costs the whole measurement (round-2 lesson). Retries with backoff,
     and on final failure returns the exception so main() can emit a
-    structured "backend unavailable" JSON instead of rc=1.
+    structured "backend unavailable" JSON instead of rc=1. A claim that
+    HANGS instead of raising is handled by the watchdog (round-3 lesson).
     """
-    import time as _time
-
+    claim_budget = float(os.environ.get("BENCH_CLAIM_TIMEOUT_S", "420"))
+    watchdog.phase(
+        f"backend claim did not return within {claim_budget:.0f}s "
+        "(chip grant wedged; claim hangs instead of raising)", claim_budget)
+    t_end = time.monotonic() + claim_budget
     retries = max(1, int(os.environ.get("BENCH_RETRIES", "3")))
     backoff = float(os.environ.get("BENCH_RETRY_BACKOFF_S", "30"))
     last = None
@@ -42,24 +147,42 @@ def _claim_backend():
             last = e
             print(f"# backend claim attempt {attempt + 1}/{retries} failed: "
                   f"{e}", file=sys.stderr)
-            if attempt + 1 < retries:
-                _time.sleep(backoff * (attempt + 1))
+            wait = backoff * (attempt + 1)
+            if attempt + 1 < retries and time.monotonic() + wait < t_end:
+                time.sleep(wait)
+            elif attempt + 1 < retries:
+                break  # out of claim budget; fail structured, don't hang
     return None, last
 
 
 def main():
+    # the watchdog covers hangs; this covers raises (an XlaRuntimeError/OOM
+    # after the claim must also end in a parseable JSON line, not rc=1)
+    try:
+        _main_measured()
+    except Exception as e:  # noqa: BLE001 - emit JSON for ANY failure
+        print(_result_json(0.0, error=f"{type(e).__name__}: {e}"), flush=True)
+        import traceback
+
+        traceback.print_exc()
+
+
+def _main_measured():
     os.environ.setdefault("DISTMLIP_TPU_NUM_THREADS", str(os.cpu_count() or 8))
-    devs, err = _claim_backend()
+    watchdog = _Watchdog()
+    devs, err = _claim_backend(watchdog)
     if devs is None:
         # structured failure: the driver records WHY instead of a traceback
-        print(json.dumps({
-            "metric": "mace_mp0_md_step_atoms_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "atoms/s",
-            "vs_baseline": 0.0,
-            "error": f"backend unavailable: {type(err).__name__}: {err}",
-        }))
+        watchdog.finish()
+        print(_result_json(
+            0.0, error=f"backend unavailable: {type(err).__name__}: {err}"))
         return
+    # claim returned: re-arm for host-side setup + on-device param init so a
+    # slow late-retry claim doesn't leave setup running on the claim budget's
+    # residue (a healthy chip would be falsely reported as a wedged claim)
+    setup_budget = float(os.environ.get("BENCH_SETUP_TIMEOUT_S", "300"))
+    watchdog.phase(f"model/system setup exceeded {setup_budget:.0f}s",
+                   setup_budget)
     import jax
 
     from distmlip_tpu import geometry
@@ -95,60 +218,35 @@ def main():
                         compute_stress=True,
                         skin=float(os.environ.get("BENCH_SKIN", "0.5")),
                         compute_dtype=bench_dtype)
+    watchdog.n_atoms = len(atoms)
+    watchdog.n_devices = len(jax.devices())
 
-    # run the measurement under a watchdog: a wedged chip grant can pass
-    # the claim (jax.devices() returns) yet hang the first compile/execute
-    # — or drop mid-run — forever (round-3 lesson). Emit structured
-    # failure instead of letting the driver record a bare timeout with no
-    # JSON. Deadline: warmup budget + a generous per-step allowance.
-    import threading
-
+    # a wedged chip grant can pass the claim (jax.devices() returns) yet
+    # hang the first compile/execute — or drop mid-run — forever (round-3
+    # lesson): keep the watchdog armed through warmup and every step
     warm_timeout = float(os.environ.get("BENCH_WARMUP_TIMEOUT_S", "600"))
-    deadline = warm_timeout + 60.0 * steps
-    done = threading.Event()
-
-    def _watchdog():
-        if not done.wait(deadline):
-            print(json.dumps({
-                "metric": "mace_mp0_md_step_atoms_per_sec_per_chip",
-                "value": 0.0,
-                "unit": "atoms/s",
-                "vs_baseline": 0.0,
-                "error": f"backend wedged: compile/execute exceeded "
-                         f"{deadline:.0f}s (chip claimed but not serving)",
-            }), flush=True)
-            os._exit(3)
-
-    threading.Thread(target=_watchdog, daemon=True).start()
+    watchdog.phase(
+        f"compile/warmup exceeded {warm_timeout:.0f}s "
+        "(chip claimed but not serving)", warm_timeout)
     pot.calculate(atoms)
     # steady state: perturb positions each step like MD
-    times = []
-    for _ in range(steps):
+    # per-step budget must absorb a mid-run XLA recompile (sticky-capacity
+    # bucket growth on a position perturbation recompiles legitimately)
+    step_budget = float(os.environ.get("BENCH_STEP_TIMEOUT_S", "300"))
+    for i in range(steps):
+        watchdog.phase(
+            f"measured step {i + 1}/{steps} exceeded {step_budget:.0f}s",
+            step_budget)
         atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
         t0 = time.perf_counter()
-        res = pot.calculate(atoms)
-        times.append(time.perf_counter() - t0)
-    done.set()  # before printing: a late watchdog firing must not emit a
-    #             second, contradictory JSON line after the success line
-    dt = float(np.median(times))
+        pot.calculate(atoms)
+        watchdog.times.append(time.perf_counter() - t0)
+    watchdog.finish()  # from here on the watchdog cannot print
+    dt = float(np.median(watchdog.times))
     atoms_per_sec = len(atoms) / dt / max(len(jax.devices()), 1)
 
-    vs = 0.0
-    base_path = os.path.join(os.path.dirname(__file__), "BASELINE_LOCAL.json")
-    if os.path.exists(base_path):
-        base = json.load(open(base_path))
-        ref = base.get("mace_mp0_md_atoms_per_sec")
-        if ref:
-            vs = atoms_per_sec / ref
-
-    print(json.dumps({
-        "metric": "mace_mp0_md_step_atoms_per_sec_per_chip",
-        "value": round(atoms_per_sec, 1),
-        "unit": "atoms/s",
-        "vs_baseline": round(vs, 3),
-        "dtype": bench_dtype,
-        "a_lmax": cfg.a_lmax,
-    }))
+    print(_result_json(atoms_per_sec, _vs_baseline(atoms_per_sec),
+                       dtype=bench_dtype, a_lmax=cfg.a_lmax))
     print(f"# n_atoms={len(atoms)} step={dt*1e3:.1f}ms rebuilds={pot.rebuild_count} "
           f"(nl={pot.last_timings['neighbor_s']*1e3:.1f}ms "
           f"part={pot.last_timings['partition_s']*1e3:.1f}ms "

@@ -11,6 +11,15 @@ from __future__ import annotations
 
 import numpy as np
 
+# In-memory tensor-layout era the saved parameters assume. Version 2 is the
+# channels-last flip (eSCN per-m flatten (C, nl)->(nl, C), edge-degree
+# reshape (C, l_max+1)->(l_max+1, C); commits 27d14ea/89c9bed): parameter
+# SHAPES are unchanged across that flip, so a pre-flip checkpoint would load
+# cleanly and silently compute wrong energies. The sentinel makes the
+# mismatch loud instead.
+LAYOUT_VERSION = 2
+_LAYOUT_KEY = "__distmlip_layout_version__"
+
 
 def _flatten_with_paths(tree, prefix=""):
     import jax
@@ -31,13 +40,28 @@ def _flatten_with_paths(tree, prefix=""):
 
 def save_params(path: str, params) -> None:
     flat = _flatten_with_paths(params)
+    flat[_LAYOUT_KEY] = np.int64(LAYOUT_VERSION)
     np.savez_compressed(path, **flat)
 
 
-def load_params(path: str, like=None):
+def load_params(path: str, like=None, *, allow_legacy_layout: bool = False):
     """Load a checkpoint; if ``like`` (a template pytree) is given, restore
-    the exact tree structure (lists vs dicts) and dtypes."""
+    the exact tree structure (lists vs dicts) and dtypes.
+
+    Refuses checkpoints from an older tensor-layout era (missing or stale
+    ``LAYOUT_VERSION`` sentinel) unless ``allow_legacy_layout=True`` —
+    shapes match across layout flips, so silent loading would be wrong.
+    """
     data = dict(np.load(path, allow_pickle=False))
+    ver = int(data.pop(_LAYOUT_KEY, 0))
+    if ver != LAYOUT_VERSION and not allow_legacy_layout:
+        raise ValueError(
+            f"checkpoint {path!r} has layout version {ver}, this build "
+            f"expects {LAYOUT_VERSION} (channels-last flip changed in-memory "
+            f"flatten order without changing parameter shapes). Re-export "
+            f"the checkpoint, or pass allow_legacy_layout=True if you know "
+            f"it was saved by this layout era."
+        )
 
     if like is None:
         # rebuild nested dicts; integer keys become dicts too

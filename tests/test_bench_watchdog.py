@@ -1,0 +1,116 @@
+"""The bench watchdog must turn ANY hang into a parseable structured-failure
+JSON line and a self-exit (rc=0) — the round-3 artifact failure was a claim
+that hung (neither raised nor returned), which the retry loop cannot catch
+and which ends in the driver SIGKILLing a mid-claim process (re-wedging the
+chip). Run in a subprocess because the watchdog exits via os._exit.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_snippet(snippet, timeout=30):
+    return subprocess.run(
+        [sys.executable, "-c", snippet], cwd=_REPO, capture_output=True,
+        text=True, timeout=timeout,
+    )
+
+
+def test_watchdog_fires_on_hung_phase_with_parseable_json():
+    r = _run_snippet(
+        "import time, bench\n"
+        "w = bench._Watchdog()\n"
+        "w.phase('simulated hung claim', 1.5)\n"
+        "time.sleep(30)\n"  # never reached: watchdog os._exit(0)s first
+    )
+    assert r.returncode == 0, r.stderr
+    line = r.stdout.strip().splitlines()[-1]
+    parsed = json.loads(line)
+    assert parsed["metric"] == bench_metric()
+    assert parsed["value"] == 0.0
+    assert "simulated hung claim" in parsed["error"]
+
+
+def test_watchdog_silent_after_finish():
+    r = _run_snippet(
+        "import time, bench\n"
+        "w = bench._Watchdog()\n"
+        "w.phase('phase that completes', 1.0)\n"
+        "w.finish()\n"
+        "time.sleep(2.5)\n"
+        "print('CLEAN_EXIT')\n"
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip().splitlines()[-1] == "CLEAN_EXIT"
+
+
+def test_watchdog_reports_partial_result():
+    r = _run_snippet(
+        "import time, bench\n"
+        "w = bench._Watchdog()\n"
+        "w.n_atoms = 1000\n"
+        "w.n_devices = 1\n"
+        "w.times.extend([0.5, 0.5, 0.6])\n"
+        "w.phase('hang after 3 good steps', 1.5)\n"
+        "time.sleep(30)\n"
+    )
+    assert r.returncode == 0, r.stderr
+    parsed = json.loads(r.stdout.strip().splitlines()[-1])
+    assert parsed["partial"] is True
+    assert parsed["value"] == 2000.0  # 1000 atoms / median 0.5 s
+    assert "3 completed steps" in parsed["error"]
+
+
+def test_watchdog_global_deadline_fires():
+    r = _run_snippet(
+        "import os, time\n"
+        "os.environ['BENCH_TOTAL_TIMEOUT_S'] = '2'\n"
+        "import bench\n"
+        "w = bench._Watchdog()\n"
+        "w.phase('roomy phase', 600.0)\n"  # per-phase never expires
+        "time.sleep(30)\n"
+    )
+    assert r.returncode == 0, r.stderr
+    parsed = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "total run exceeded" in parsed["error"]
+
+
+def test_watchdog_deadline_extends_across_phases():
+    r = _run_snippet(
+        "import time, bench\n"
+        "w = bench._Watchdog()\n"
+        "w.phase('short phase', 3.0)\n"
+        "time.sleep(1.5)\n"
+        "w.phase('next phase', 30.0)\n"  # re-arm before the first expires
+        "time.sleep(2.5)\n"
+        "w.finish()\n"
+        "print('CLEAN_EXIT')\n"
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip().splitlines()[-1] == "CLEAN_EXIT"
+
+
+def test_raise_after_claim_still_emits_json():
+    r = _run_snippet(
+        "import bench\n"
+        "def boom():\n"
+        "    raise RuntimeError('simulated XlaRuntimeError')\n"
+        "bench._main_measured = boom\n"
+        "bench.main()\n"
+    )
+    assert r.returncode == 0, r.stderr
+    parsed = json.loads(r.stdout.strip().splitlines()[-1])
+    assert parsed["value"] == 0.0
+    assert "simulated XlaRuntimeError" in parsed["error"]
+    assert "Traceback" in r.stderr
+
+
+def bench_metric():
+    sys.path.insert(0, _REPO)
+    import bench
+
+    return bench._METRIC

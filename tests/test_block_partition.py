@@ -101,6 +101,7 @@ def test_block_matches_single_device_chgnet(rng):
     np.testing.assert_allclose(f1, f8, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_block_matches_single_device_mace(rng):
     """MACE on a 2x2x2 block mesh == single device (VERDICT r2 item 5)."""
     from distmlip_tpu.models import MACE, MACEConfig

@@ -195,6 +195,7 @@ def test_stacked_ensemble_matches_sequential(rng):
                                atol=1e-8)
 
 
+@pytest.mark.slow
 def test_stacked_ensemble_matches_sequential_multipartition(rng):
     """Multi-partition ensembles also run as ONE vmapped sharded program
     (the vmap batches the whole shard_map'd graph-parallel step); results

@@ -115,6 +115,7 @@ SMALL = MACEConfig(
 )
 
 
+@pytest.mark.slow
 def test_mace_mapping_full_coverage():
     """Every tensor in a ScaleShiftMACE-shaped dict maps (zero unmapped)."""
     rng = np.random.default_rng(0)
@@ -178,6 +179,7 @@ def test_mace_mapping_numerics():
     np.testing.assert_allclose(params["zbl"]["a_exp"], 0.3)
 
 
+@pytest.mark.slow
 def test_mace_mapping_mp0_medium_shapes():
     """The VERDICT done-criterion: a MACE-MP-0-medium-shaped checkpoint
     (89 elements, 128 channels, l_max 3, correlation 3, hidden 0e+1o,

@@ -20,6 +20,9 @@ from distmlip_tpu.models.chgnet import CHGNet, CHGNetConfig
 from distmlip_tpu.models.convert import from_torch
 from tests.utils import run_potential
 
+# converter goldens are slow-lane: they re-run the torch oracle forward
+pytestmark = pytest.mark.slow
+
 torch.manual_seed(0)
 
 

@@ -18,6 +18,9 @@ from distmlip_tpu.models.convert import from_torch
 from tests.test_convert_chgnet import TMLP
 from tests.utils import run_potential
 
+# converter goldens are slow-lane: they re-run the torch oracle forward
+pytestmark = pytest.mark.slow
+
 S, C, R, NL = 4, 8, 6, 2
 CUT = 3.0
 

@@ -167,6 +167,7 @@ def params6():
     return MODEL6.init(jax.random.PRNGKey(1))
 
 
+@pytest.mark.slow
 def test_lmax6_distributed_matches_single(rng, params6):
     import time
 
@@ -186,6 +187,7 @@ def test_lmax6_distributed_matches_single(rng, params6):
     np.testing.assert_allclose(f1, f2, atol=3e-4)
 
 
+@pytest.mark.slow
 def test_lmax6_rotation_invariance_and_fd(rng, params6):
     jax.config.update("jax_enable_x64", True)
     try:
@@ -226,6 +228,7 @@ def test_lmax6_rotation_invariance_and_fd(rng, params6):
         jax.config.update("jax_enable_x64", False)
 
 
+@pytest.mark.slow
 def test_edge_chunking_matches_unchunked(rng, params):
     """K>1 edge-chunked scan (with remat) must reproduce the unchunked
     pipeline exactly — the chunk boundary must not leak into Wigner

@@ -11,6 +11,7 @@ including csd conditioning.
 import jax
 import numpy as np
 import optax
+import pytest
 
 from distmlip_tpu.models import ESCN, ESCNConfig
 from distmlip_tpu.neighbors import neighbor_list_numpy
@@ -38,6 +39,7 @@ def _graphs(rng, n_structs=3, P=2):
     return out
 
 
+@pytest.mark.slow
 def test_uma_retrain_recipe_distills_teacher(rng):
     """Student eSCN fits a frozen teacher's energies+forces over a P=2 mesh:
     the loss must drop by >5x in a few dozen steps, and the distilled
@@ -81,6 +83,7 @@ def test_uma_retrain_recipe_distills_teacher(rng):
     assert err.max() < 0.1, err.max()
 
 
+@pytest.mark.slow
 def test_training_gradients_flow_through_halo(rng):
     """Parameter gradients must agree between P=1 and P=2 for the same
     structure — i.e. the loss differentiates correctly through the halo

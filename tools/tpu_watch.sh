@@ -9,13 +9,18 @@
 rm -f /tmp/tpu_up
 while true; do
   ts=$(date +%H:%M:%S)
-  out=$(timeout 1200 python -c "
+  # no pipe here: rc must reflect timeout's 124, not tail's 0 (a pipe
+  # made the 20-min backoff branch dead code and re-wedged the chip)
+  probe_out=$(mktemp)
+  timeout 1200 python -c "
 import jax, jax.numpy as jnp
 d = jax.devices()
 x = jnp.ones((256, 256), jnp.bfloat16)
 print('OK', d[0].platform, d[0].device_kind, float((x @ x).sum()))
-" 2>&1 | tail -1)
+" > "$probe_out" 2>&1
   rc=$?
+  out=$(tail -1 "$probe_out")
+  rm -f "$probe_out"
   echo "$ts rc=$rc $out" >> /tmp/tpu_watch.log
   if [[ "$out" == OK* ]]; then
     echo "$ts $out" > /tmp/tpu_up

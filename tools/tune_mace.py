@@ -61,6 +61,8 @@ def time_config(atoms, rng, *, remat, edge_chunk, node_chunk,
 
 
 def main():
+    from bench_common import build_bench_atoms
+
     quick = "--quick" in sys.argv
     atoms, rng = build_bench_atoms()
     configs = [

@@ -3,6 +3,7 @@ from .tensornet import TensorNet, TensorNetConfig
 from .chgnet import CHGNet, CHGNetConfig
 from .mace import MACE, MACEConfig
 from .escn import ESCN, ESCNConfig
+from .escn_md import ESCNMD, ESCNMDConfig
 
 __all__ = [
     "PairPotential", "PairConfig",
@@ -10,4 +11,5 @@ __all__ = [
     "CHGNet", "CHGNetConfig",
     "MACE", "MACEConfig",
     "ESCN", "ESCNConfig",
+    "ESCNMD", "ESCNMDConfig",
 ]

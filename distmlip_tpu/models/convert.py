@@ -815,6 +815,135 @@ def tensornet_mapping(params, sd, model=None):
     return rules
 
 
+# ---------------------------------------------------------------------------
+# eSCN / UMA (fairchem eSCNMDBackbone) mapping
+# ---------------------------------------------------------------------------
+
+
+def _rad_rules(prefix: str, path: tuple) -> list[Rule]:
+    """RadialFunction (Linear -> LayerNorm -> SiLU -> Linear) under
+    fairchem's Sequential numbering: net.0 Linear, net.1 LayerNorm,
+    net.3 final Linear. ESCNMD stores linears torch-shaped (out, in), so
+    no transpose."""
+    return [
+        Rule(f"{prefix}.net.0.weight", path + ("lins", 0, "w")),
+        Rule(f"{prefix}.net.0.bias", path + ("lins", 0, "b")),
+        Rule(f"{prefix}.net.1.weight", path + ("lns", 0, "g")),
+        Rule(f"{prefix}.net.1.bias", path + ("lns", 0, "b")),
+        Rule(f"{prefix}.net.3.weight", path + ("lins", 1, "w")),
+        Rule(f"{prefix}.net.3.bias", path + ("lins", 1, "b")),
+    ]
+
+
+def _so2_rules(prefix: str, path: tuple, m_max: int,
+               internal: bool) -> list[Rule]:
+    """SO2_Convolution: fc_m0 (+bias) and per-|m| so2_m_conv.{m-1}.fc
+    weights (bias-free complex pairs, output = [real | imag] halves).
+    MOLE checkpoints carry the same names with a leading expert axis —
+    shapes are validated against the params leaf by set_in. ``internal``
+    marks fairchem's internal_weights=True convs (no rad_func)."""
+    rules = [
+        Rule(f"{prefix}.fc_m0.weight", path + ("m0",)),
+        Rule(f"{prefix}.fc_m0.bias", path + ("m0_b",)),
+    ]
+    for m in range(1, m_max + 1):
+        rules.append(Rule(f"{prefix}.so2_m_conv.{m - 1}.fc.weight",
+                          path + (f"m{m}",)))
+    if not internal:
+        rules += _rad_rules(f"{prefix}.rad_func", path + ("rad",))
+    return rules
+
+
+@register_mapping("escn")
+def escn_mapping(params, sd, model=None):
+    """fairchem ``eSCNMDBackbone.state_dict()`` -> ESCNMD params.
+
+    Closes the last unconverted family (the reference's UMA flagship,
+    from_existing at implementations/uma/escn_md.py:559-569). The key
+    layout follows the surface visible through the reference wrapper
+    (sphere/source/target embeddings, distance_expansion, csd_embedding,
+    edge_degree_embedding, blocks[i] with SO(2) convolutions, final norm
+    — escn_md.py:221-247,319-330,443-516) with block internals
+    reconstructed from the public equiformer_v2/eSCN lineage; the float64
+    torch oracle in tests/test_convert_escn.py is the golden contract.
+    A ``backbone.`` prefix (whole-model UMA dumps) is handled; head
+    tensors map onto the energy head when present.
+    """
+    p = "backbone." if any(k.startswith("backbone.") for k in sd) else ""
+    cfg = model.cfg if model is not None else None
+    n_blocks = len(params["blocks"])
+    m_max = (cfg.mmax if cfg is not None
+             else len([k for k in sd
+                       if f"{p}blocks.0.so2_conv_1.so2_m_conv." in k
+                       and k.endswith(".fc.weight")]))
+
+    rules: list[Rule] = [
+        Rule(p + "sphere_embedding.weight", ("sphere_embedding", "w")),
+        Rule(p + "source_embedding.weight", ("source_embedding", "w")),
+        Rule(p + "target_embedding.weight", ("target_embedding", "w")),
+        Rule(p + "csd_embedding.charge_embedding.weight",
+             ("csd", "charge", "w")),
+        Rule(p + "csd_embedding.spin_embedding.weight", ("csd", "spin", "w")),
+        Rule(p + "csd_embedding.dataset_embedding.weight",
+             ("csd", "dataset", "w")),
+        Rule(p + "csd_embedding.mix_csd.weight", ("csd", "mix", "w")),
+        Rule(p + "csd_embedding.mix_csd.bias", ("csd", "mix", "b")),
+        Rule(p + "norm.affine_weight", ("norm", "w")),
+    ]
+    rules += _rad_rules(p + "edge_degree_embedding.rad_func",
+                        ("edge_deg_rad",))
+
+    # distance_expansion: a gaussian-offset buffer, not weights — validate
+    # it matches the linspace(0, cutoff, num_distance_basis) this framework
+    # hardcodes rather than consuming it silently
+    if p + "distance_expansion.offset" in sd and cfg is not None:
+        def check_offsets(a, _cfg=cfg):
+            want = np.linspace(0.0, _cfg.cutoff, _cfg.num_distance_basis)
+            got = np.ravel(np.asarray(a, dtype=np.float64))
+            if got.size != want.size or not np.allclose(got, want, atol=1e-5):
+                raise ValueError(
+                    "checkpoint gaussian offsets differ from "
+                    "linspace(0, cutoff, num_distance_basis)")
+        rules.append(Rule(p + "distance_expansion.offset", None,
+                          check_offsets))
+
+    for i in range(n_blocks):
+        bp = f"{p}blocks.{i}."
+        path = ("blocks", i)
+        rules.append(Rule(bp + "norm_1.affine_weight", path + ("norm1", "w")))
+        rules += _so2_rules(bp + "so2_conv_1", path + ("so2_1",), m_max,
+                            internal=False)
+        rules += _so2_rules(bp + "so2_conv_2", path + ("so2_2",), m_max,
+                            internal=True)
+        rules.append(Rule(bp + "ff_norm.affine_weight",
+                          path + ("ff_norm", "w")))
+        rules.append(Rule(bp + "ff.so3_linear_1.weight",
+                          path + ("ff", "lin1", "w")))
+        rules.append(Rule(bp + "ff.so3_linear_1.bias",
+                          path + ("ff", "lin1", "b")))
+        rules.append(Rule(bp + "ff.gating_linear.weight",
+                          path + ("ff", "gate", "w")))
+        rules.append(Rule(bp + "ff.gating_linear.bias",
+                          path + ("ff", "gate", "b")))
+        rules.append(Rule(bp + "ff.so3_linear_2.weight",
+                          path + ("ff", "lin2", "w")))
+        rules.append(Rule(bp + "ff.so3_linear_2.bias",
+                          path + ("ff", "lin2", "b")))
+
+    # energy head (fairchem heads are separate modules; a whole-model dump
+    # carries them as heads.energy.*)
+    for hp in ("heads.energy.mlp.", "energy_head.mlp."):
+        if any(k.startswith(hp) for k in sd):
+            rules += [
+                Rule(hp + "0.weight", ("energy_head", "lin1", "w")),
+                Rule(hp + "0.bias", ("energy_head", "lin1", "b")),
+                Rule(hp + "2.weight", ("energy_head", "lin2", "w")),
+                Rule(hp + "2.bias", ("energy_head", "lin2", "b")),
+            ]
+            break
+    return rules
+
+
 def jax_zero_like(tree):
     import jax
 

@@ -1,0 +1,484 @@
+"""ESCNMD — UMA/fairchem-parameterized eSCN backbone (weight-ingestible).
+
+Where ``models/escn.py`` implements the eSCN *capabilities* in this repo's
+own parameterization, this model reconstructs the fairchem ``eSCNMDBackbone``
+surface tensor-for-tensor so pretrained UMA-family checkpoints can be
+converted (MAPPINGS["escn"], models/convert.py) — the same discipline the
+CHGNet/TensorNet rewrites applied to matgl. The reconstruction is pinned by
+the reference wrapper's visible usage (reference
+implementations/uma/escn_md.py):
+
+- per-edge Wigner matrices via the Jd-table pipeline ``X(a) J X(b) J``
+  in e3nn's y-polar basis (escn_md.py:74-130) — ops/so3_e3nn, tables
+  derived from scratch and validated against the shipped Jd.pt;
+- m-major coefficient packing for the SO(2) convolutions with (cos, sin)
+  pairs mixed by (W_r, W_i) blocks (the to_m mapping, escn_md.py:117-129);
+- mmax narrowing of edge-frame coefficients (escn_md.py:111-114);
+- node features (N, (lmax+1)^2, C) with scalars initialized from the
+  species embedding plus the per-system csd (charge/spin/dataset)
+  embedding (escn_md.py:319-330);
+- edge scalars = cat(gaussian distance expansion, source species emb,
+  target species emb) feeding both the edge-degree embedding and the
+  SO(2) radial scaling (escn_md.py:221-247);
+- MOLE: SO(2) weights as per-system convex expert mixtures, coefficients
+  replicated/psum-consistent across partitions (escn_md.py:343-357).
+
+Internals fairchem does NOT expose through the wrapper (block wiring,
+norm/activation/FFN details, RadialFunction shape) are reconstructed from
+the public equiformer_v2/eSCN lineage and documented inline; every such
+choice is mirrored exactly by the float64 torch oracle in
+tests/test_convert_escn.py, which is the converter's golden contract.
+Layout is channels-LAST (C in the TPU lane axis) per the round-3 finding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import radial
+from ..ops.nn import cast_params_subtrees
+from ..ops.segment import masked_segment_sum
+from ..ops.so3_e3nn import CoeffLayout, jd_np, _z_rot_jnp, edge_angles
+
+
+@dataclass(frozen=True)
+class ESCNMDConfig:
+    max_num_elements: int = 100
+    sphere_channels: int = 64       # C
+    lmax: int = 2
+    mmax: int = 2
+    num_layers: int = 2
+    hidden_channels: int = 64       # SO(2) conv hidden width
+    edge_channels: int = 32         # species embeddings + rad_func hidden
+    num_distance_basis: int = 64    # gaussian smearing resolution
+    cutoff: float = 5.0
+    avg_degree: float = 14.0        # edge-degree + message rescale factor
+    num_experts: int = 1            # > 1: MOLE mixtures on SO(2) weights
+    # csd conditioning (UMA charge/spin/dataset, escn_md.py:255-265)
+    num_charges: int = 25
+    charge_min: int = -12
+    num_spins: int = 10
+    num_datasets: int = 4
+    use_envelope: bool = True       # smooth cutoff on messages + edge-degree
+    edge_chunk: int = 32768         # lax.scan edge chunking (0 = off)
+    remat: bool = True
+    dtype: str = "float32"
+
+    @property
+    def sphere_dim(self) -> int:
+        return (self.lmax + 1) ** 2
+
+
+def _rand(key, shape, scale):
+    return scale * jax.random.normal(key, shape)
+
+
+def _linear_init(key, d_in, d_out, bias=True):
+    k1, k2 = jax.random.split(key)
+    lim = 1.0 / np.sqrt(d_in)
+    p = {"w": jax.random.uniform(k1, (d_out, d_in), minval=-lim, maxval=lim)}
+    if bias:
+        p["b"] = jax.random.uniform(k2, (d_out,), minval=-lim, maxval=lim)
+    return p
+
+
+def _linear(p, x):
+    y = x @ p["w"].T
+    if "b" in p:
+        y = y + p["b"]
+    return y
+
+
+def _rad_init(key, dims):
+    """RadialFunction (equiformer_v2 lineage): Linear -> LayerNorm -> SiLU
+    per intermediate stage, bare Linear last. dims = [in, hidden, out]."""
+    ks = jax.random.split(key, len(dims))
+    p = {"lins": [], "lns": []}
+    for i in range(len(dims) - 1):
+        p["lins"].append(_linear_init(ks[i], dims[i], dims[i + 1]))
+        if i < len(dims) - 2:
+            p["lns"].append({"g": jnp.ones((dims[i + 1],)),
+                             "b": jnp.zeros((dims[i + 1],))})
+    return p
+
+
+def _rad_apply(p, x):
+    n = len(p["lins"])
+    for i in range(n):
+        x = _linear(p["lins"][i], x)
+        if i < n - 1:
+            ln = p["lns"][i]
+            mu = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            x = (x - mu) * jax.lax.rsqrt(var + 1e-5) * ln["g"] + ln["b"]
+            x = jax.nn.silu(x)
+    return x
+
+
+class ESCNMD:
+    supports_compute_dtype = True
+
+    def __init__(self, config: ESCNMDConfig = ESCNMDConfig()):
+        if config.lmax > 6:
+            raise NotImplementedError("lmax > 6: extend ops/so3 tables")
+        self.cfg = config
+        self.lay = CoeffLayout(config.lmax, config.mmax)
+        # rad_func per-coefficient scaling vector length (input channels
+        # per coefficient x paired coefficients per |m|), m = 0..mmax
+        self._rad_splits = [
+            self.lay.m_size(m) for m in range(self.lay.m_max + 1)
+        ]
+
+    # ---- parameters (shapes mirror the fairchem state dict 1:1) ----
+    def init(self, key) -> dict:
+        cfg = self.cfg
+        C, H, Ce = cfg.sphere_channels, cfg.hidden_channels, cfg.edge_channels
+        Dx = cfg.num_distance_basis + 2 * Ce
+        K = cfg.num_experts
+        lay = self.lay
+        ks = iter(jax.random.split(key, 32 + cfg.num_layers * 16))
+
+        def so2_weights(c_in, c_out, extra_m0, internal):
+            p = {}
+            m0_in = lay.m_size(0) * c_in
+            m0_out = lay.m_size(0) * c_out + extra_m0
+            shape0 = (K, m0_out, m0_in) if K > 1 else (m0_out, m0_in)
+            lim = 1.0 / np.sqrt(m0_in)
+            p["m0"] = jax.random.uniform(next(ks), shape0, minval=-lim,
+                                         maxval=lim)
+            p["m0_b"] = jnp.zeros((m0_out,))
+            for m in range(1, lay.m_max + 1):
+                nl = lay.m_size(m)
+                shape = ((K, 2 * nl * c_out, nl * c_in) if K > 1
+                         else (2 * nl * c_out, nl * c_in))
+                lim = 1.0 / np.sqrt(nl * c_in)
+                p[f"m{m}"] = jax.random.uniform(next(ks), shape, minval=-lim,
+                                                maxval=lim)
+            if not internal:
+                p["rad"] = _rad_init(
+                    next(ks), [Dx, Ce, sum(self._rad_splits) * c_in])
+            return p
+
+        params = {
+            "sphere_embedding": {"w": _rand(next(ks), (cfg.max_num_elements, C), 1.0)},
+            "source_embedding": {"w": _rand(next(ks), (cfg.max_num_elements, Ce), 1.0)},
+            "target_embedding": {"w": _rand(next(ks), (cfg.max_num_elements, Ce), 1.0)},
+            "csd": {
+                "charge": {"w": _rand(next(ks), (cfg.num_charges, C), 1.0)},
+                "spin": {"w": _rand(next(ks), (cfg.num_spins, C), 1.0)},
+                "dataset": {"w": _rand(next(ks), (cfg.num_datasets, C), 1.0)},
+                "mix": _linear_init(next(ks), 3 * C, C),
+            },
+            "edge_deg_rad": _rad_init(next(ks), [Dx, Ce, (cfg.lmax + 1) * C]),
+            "blocks": [],
+            "norm": {"w": jnp.ones((cfg.lmax + 1, C))},
+            "energy_head": {
+                "lin1": _linear_init(next(ks), C, C),
+                "lin2": _linear_init(next(ks), C, 1),
+            },
+            "species_ref": {"w": jnp.zeros((cfg.max_num_elements,))},
+        }
+        if K > 1:
+            params["mole_gate"] = {
+                "lin1": _linear_init(next(ks), 2 * C, C),
+                "lin2": _linear_init(next(ks), C, K),
+            }
+        for _ in range(cfg.num_layers):
+            params["blocks"].append({
+                "norm1": {"w": jnp.ones((cfg.lmax + 1, C))},
+                "so2_1": so2_weights(2 * C, H, cfg.lmax * H, internal=False),
+                "so2_2": so2_weights(H, C, 0, internal=True),
+                "ff_norm": {"w": jnp.ones((cfg.lmax + 1, C))},
+                "ff": {
+                    "lin1": {"w": _rand(next(ks), (cfg.lmax + 1, H, C),
+                                        1.0 / np.sqrt(C)),
+                             "b": jnp.zeros((H,))},
+                    "gate": _linear_init(next(ks), C, cfg.lmax * H),
+                    "lin2": {"w": _rand(next(ks), (cfg.lmax + 1, C, H),
+                                        1.0 / np.sqrt(H)),
+                             "b": jnp.zeros((C,))},
+                },
+            })
+        return params
+
+    # ---- building blocks -------------------------------------------------
+    def _rms_norm_sh(self, w, x):
+        """Degree-balanced RMS norm with per-(l, channel) affine weight
+        (rms_norm_sh: each coefficient weighted 1/(2l+1)/(lmax+1) so every
+        degree contributes equally to the norm; no centering, no bias)."""
+        cfg = self.cfg
+        bal = np.zeros((cfg.sphere_dim,), dtype=np.float64)
+        o = 0
+        for l in range(cfg.lmax + 1):
+            bal[o:o + 2 * l + 1] = 1.0 / ((2 * l + 1) * (cfg.lmax + 1))
+            o += 2 * l + 1
+        bal_j = jnp.asarray(bal, dtype=x.dtype)
+        ms = jnp.mean(jnp.sum(x * x * bal_j[:, None], axis=-2), axis=-1)
+        x = x * jax.lax.rsqrt(ms + 1e-12)[..., None, None]
+        w_full = jnp.repeat(w.astype(x.dtype),
+                            np.array([2 * l + 1 for l in range(cfg.lmax + 1)]),
+                            axis=0)
+        return x * w_full
+
+    def _so2_mix(self, W, mole):
+        """Collapse the expert axis with the per-system MOLE coefficients."""
+        if self.cfg.num_experts > 1:
+            return jnp.einsum("k,kab->ab", mole.astype(W.dtype), W)
+        return W
+
+    def _so2_conv(self, p, fr, rad_scale, mole, c_in, c_out, extra_m0):
+        """SO(2) convolution on edge-frame features fr (E_c, S_nar, c_in).
+
+        Per |m|, the (l >= m) coefficients flatten l-major to (nl * c_in)
+        and pass through one linear map; m > 0 uses the (W_r, W_i) complex
+        pair structure y+ = W_r f+ - W_i f-, y- = W_r f- + W_i f+ (the
+        fairchem SO2_m_Convolution packing: fc output = [real | imag]
+        halves). ``rad_scale``: optional per-coefficient input scaling from
+        the radial function, same scale for the +m and -m partners."""
+        lay = self.lay
+        E = fr.shape[0]
+        y = jnp.zeros((E, lay.size, c_out), dtype=fr.dtype)
+        extra = None
+        off = 0
+        for m in range(lay.m_max + 1):
+            nl = lay.m_size(m)
+            if m == 0:
+                f0 = fr[:, lay.plus_idx[0], :].reshape(E, nl * c_in)
+                if rad_scale is not None:
+                    f0 = f0 * rad_scale[:, off:off + nl * c_in]
+                W0 = self._so2_mix(p["m0"], mole)
+                out0 = f0 @ W0.T + p["m0_b"].astype(fr.dtype)
+                main, extra = (out0[:, :nl * c_out], out0[:, nl * c_out:])
+                y = y.at[:, lay.plus_idx[0], :].set(
+                    main.reshape(E, nl, c_out))
+            else:
+                fp = fr[:, lay.plus_idx[m], :].reshape(E, nl * c_in)
+                fm = fr[:, lay.minus_idx[m], :].reshape(E, nl * c_in)
+                if rad_scale is not None:
+                    s = rad_scale[:, off:off + nl * c_in]
+                    fp, fm = fp * s, fm * s
+                W = self._so2_mix(p[f"m{m}"], mole)
+                d_out = nl * c_out
+                Wr, Wi = W[:d_out], W[d_out:]
+                yp = fp @ Wr.T - fm @ Wi.T
+                ym = fm @ Wr.T + fp @ Wi.T
+                y = y.at[:, lay.plus_idx[m], :].set(yp.reshape(E, nl, c_out))
+                y = y.at[:, lay.minus_idx[m], :].set(ym.reshape(E, nl, c_out))
+            off += nl * c_in
+        return (y, extra) if extra_m0 else y
+
+    def _gate_act(self, x, gates, full_layout=False):
+        """Gate activation: scalars -> silu, l > 0 coefficients scaled by
+        sigmoid(per-l gate scalars) broadcast over m. ``full_layout``
+        selects (lmax+1)^2 node-block slices instead of the mmax-narrowed
+        edge-frame slices."""
+        cfg, lay = self.cfg, self.lay
+        E, H = gates.shape[0], cfg.hidden_channels
+        g = jax.nn.sigmoid(gates.reshape(E, cfg.lmax, H))
+        y = x.at[:, 0, :].set(jax.nn.silu(x[:, 0, :]))
+        for l in range(1, cfg.lmax + 1):
+            sl = (slice(l * l, l * l + 2 * l + 1) if full_layout
+                  else lay.block_slices[l])
+            y = y.at[:, sl, :].multiply(g[:, l - 1][:, None, :])
+        return y
+
+    def _ffn(self, p, x):
+        """Feed-forward: per-l SO3 linear -> gate activation -> SO3 linear
+        (gate-type FFN; scalars get the l=0 bias)."""
+        cfg, lay = self.cfg, self.lay
+        gates = _linear(p["gate"], x[:, 0, :])  # from input scalars
+        h = jnp.einsum("nsc,shc->nsh", x, self._expand_lweights(p["lin1"]["w"], x.dtype))
+        h = h.at[:, 0, :].add(p["lin1"]["b"].astype(x.dtype))
+        h = self._gate_act(h, gates, full_layout=True)
+        y = jnp.einsum("nsh,sch->nsc", h, self._expand_lweights(p["lin2"]["w"], x.dtype))
+        y = y.at[:, 0, :].add(p["lin2"]["b"].astype(x.dtype))
+        return y
+
+    def _expand_lweights(self, w, dtype):
+        """(lmax+1, a, b) per-degree weights -> (S, a, b) per-coefficient."""
+        reps = np.array([2 * l + 1 for l in range(self.cfg.lmax + 1)])
+        return jnp.repeat(w.astype(dtype), reps, axis=0)
+
+    # ---- forward ---------------------------------------------------------
+    def energy_fn(self, params, lg, positions):
+        cfg, lay = self.cfg, self.lay
+        C, H, S = cfg.sphere_channels, cfg.hidden_channels, cfg.sphere_dim
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else positions.dtype
+        if cfg.dtype == "bfloat16":
+            params = cast_params_subtrees(
+                params, dtype, keep_fp32=("species_ref", "energy_head"))
+
+        # fairchem's edge vector points src -> ... pos[src] - pos[dst]
+        # (reference compute.py:169-173); lg.edge_vectors is dst - src
+        vec = -lg.edge_vectors(positions)
+        d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
+        # masked (padding) edges get a fixed safe direction: their rhat is
+        # (0,0,0), and atan2's gradient at the origin is NaN — which would
+        # poison the whole force array through the 0-weighted messages
+        safe = jnp.asarray([0.0, 0.0, 1.0], dtype=positions.dtype)
+        rhat = jnp.where(lg.edge_mask[:, None],
+                         vec / jnp.maximum(d, 1e-9)[:, None], safe)
+        env = (
+            radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask
+            if cfg.use_envelope else lg.edge_mask.astype(positions.dtype)
+        ).astype(dtype)
+        # gaussian smearing over [0, cutoff]
+        centers = jnp.linspace(0.0, cfg.cutoff, cfg.num_distance_basis)
+        width = cfg.cutoff / (cfg.num_distance_basis - 1)
+        gauss = jnp.exp(-0.5 * ((d[:, None] - centers) / width) ** 2
+                        ).astype(dtype)
+
+        z = jnp.asarray(lg.species)
+        zemb = params["sphere_embedding"]["w"][z].astype(dtype)
+
+        # csd (charge/spin/dataset) system embedding
+        sys_state = lg.system or {}
+        qi = jnp.clip(jnp.asarray(sys_state.get("charge", 0)) - cfg.charge_min,
+                      0, cfg.num_charges - 1)
+        si = jnp.clip(jnp.asarray(sys_state.get("spin", 0)), 0, cfg.num_spins - 1)
+        di = jnp.clip(jnp.asarray(sys_state.get("dataset", 0)), 0,
+                      cfg.num_datasets - 1)
+        csd = _linear(params["csd"]["mix"], jnp.concatenate([
+            params["csd"]["charge"]["w"][qi],
+            params["csd"]["spin"]["w"][si],
+            params["csd"]["dataset"]["w"][di],
+        ], axis=-1).astype(dtype))  # (C,)
+
+        h = jnp.zeros((positions.shape[0], S, C), dtype=dtype)
+        h = h.at[:, 0, :].set(zemb + csd[None, :])
+
+        # MOLE coefficients: psum-consistent composition + csd gate
+        if cfg.num_experts > 1:
+            owned = lg.owned_mask.astype(dtype)[:, None]
+            comp = lg.psum(jnp.sum(zemb * owned, axis=0))
+            count = lg.psum(jnp.sum(owned))
+            gate_in = jnp.concatenate([comp / jnp.maximum(count, 1.0), csd])
+            g = jax.nn.silu(_linear(params["mole_gate"]["lin1"], gate_in))
+            mole = jax.nn.softmax(_linear(params["mole_gate"]["lin2"], g))
+        else:
+            mole = None
+
+        # --- edge-chunked scan scaffolding (shared with models/escn.py) ---
+        from ..ops.chunk import (chunk_spec, chunked, pad_index, pad_rows,
+                                 scan_accumulate)
+
+        e_cap = lg.edge_src.shape[0]
+        K_ch, chunk, pad = chunk_spec(e_cap, cfg.edge_chunk)
+        edge_xs = (
+            chunked(pad_index(lg.edge_src, pad), K_ch, chunk),
+            chunked(pad_index(lg.edge_dst, pad), K_ch, chunk),
+            chunked(pad_rows(lg.edge_mask, pad), K_ch, chunk),
+            chunked(pad_rows(rhat, pad), K_ch, chunk),
+            chunked(pad_rows(gauss, pad), K_ch, chunk),
+            chunked(pad_rows(env, pad), K_ch, chunk),
+        )
+
+        def wigner_blocks(rhatc):
+            """Per-l lab-from-edge blocks. Built at >= fp32 (never bf16:
+            the trig chains compound) in the geometry precision, downcast
+            per-use in rotate_in/rotate_out."""
+            wdt = jnp.promote_types(rhatc.dtype, jnp.float32)
+            alpha, beta = edge_angles(rhatc.astype(wdt))
+            out = []
+            for l in range(cfg.lmax + 1):
+                J = jnp.asarray(jd_np(l), dtype=wdt)
+                D = jnp.einsum("epq,qr,ers,st->ept",
+                               _z_rot_jnp(l, alpha), J,
+                               _z_rot_jnp(l, beta), J)
+                out.append(D)
+            return out
+
+        def rotate_in(hvecs, D):
+            """Lab (E_c, S_full, c) -> edge frame (E_c, S_nar, c): transpose
+            blocks, keep the center 2*min(l,mmax)+1 rows."""
+            parts = []
+            for l in range(cfg.lmax + 1):
+                rows = lay.block_rows(l)
+                Dl = D[l][:, :, rows].astype(hvecs.dtype)  # (E, 2l+1, nar)
+                o = l * l
+                parts.append(jnp.einsum(
+                    "epn,epc->enc", Dl, hvecs[:, o:o + 2 * l + 1, :]))
+            return jnp.concatenate(parts, axis=1)
+
+        def rotate_out(y, D):
+            """Edge frame (E_c, S_nar, c) -> lab (E_c, S_full, c)."""
+            parts = []
+            for l in range(cfg.lmax + 1):
+                rows = lay.block_rows(l)
+                Dl = D[l][:, :, rows].astype(y.dtype)
+                parts.append(jnp.einsum("epn,enc->epc", Dl,
+                                        y[:, lay.block_slices[l], :]))
+            return jnp.concatenate(parts, axis=1)
+
+        def edge_scan(per_chunk, out_shape):
+            def body(acc, xs):
+                srcc, dstc, maskc, rhatc, gaussc, envc = xs
+                D = wigner_blocks(rhatc)
+                msg = per_chunk(srcc, dstc, maskc, D, gaussc, envc)
+                return (
+                    acc + masked_segment_sum(
+                        msg, dstc, lg.n_cap, maskc, indices_are_sorted=True),
+                    None,
+                )
+
+            acc0 = jnp.zeros((lg.n_cap,) + out_shape, dtype=dtype)
+            return scan_accumulate(body, acc0, edge_xs, remat=cfg.remat)
+
+        def edge_scalars(srcc, dstc, gaussc):
+            return jnp.concatenate([
+                gaussc,
+                params["source_embedding"]["w"][z[srcc]].astype(dtype),
+                params["target_embedding"]["w"][z[dstc]].astype(dtype),
+            ], axis=-1)
+
+        # --- edge-degree embedding (escn_md.py:221-247): radial weights
+        # placed in the edge frame's m=0 slots, rotated to the lab frame,
+        # degree-summed onto the receiver, / avg_degree
+        def deg_chunk(srcc, dstc, maskc, D, gaussc, envc):
+            w = _rad_apply(params["edge_deg_rad"], edge_scalars(srcc, dstc, gaussc))
+            w = w.reshape(-1, cfg.lmax + 1, C)
+            y = jnp.zeros((w.shape[0], lay.size, C), dtype=dtype)
+            y = y.at[:, lay.plus_idx[0], :].set(w)
+            return rotate_out(y, D) * env_mult(envc)
+
+        def env_mult(envc):
+            return envc[:, None, None]
+
+        inv_deg = jnp.asarray(1.0 / cfg.avg_degree, dtype=dtype)
+        h = h + edge_scan(deg_chunk, (S, C)) * inv_deg
+        h = lg.halo_exchange(h)
+
+        for blk in params["blocks"]:
+
+            def so2_chunk(srcc, dstc, maskc, D, gaussc, envc, blk=blk):
+                xe = edge_scalars(srcc, dstc, gaussc)
+                rad = _rad_apply(blk["so2_1"]["rad"], xe)  # per-coeff scales
+                xn_src = hn[srcc]
+                xn_dst = hn[dstc]
+                fr = jnp.concatenate([
+                    rotate_in(xn_src, D), rotate_in(xn_dst, D)], axis=-1)
+                y, gates = self._so2_conv(
+                    blk["so2_1"], fr, rad, mole, 2 * C, H, cfg.lmax * H)
+                y = self._gate_act(y, gates)
+                y = self._so2_conv(blk["so2_2"], y, None, mole, H, C, 0)
+                return rotate_out(y, D) * env_mult(envc)
+
+            # message path reads the NORMALIZED features (with the system
+            # embedding re-injected into the scalars); residual keeps h
+            hn = self._rms_norm_sh(blk["norm1"]["w"], h)
+            hn = hn.at[:, 0, :].add(csd[None, :])
+            h = h + edge_scan(so2_chunk, (S, C)) * inv_deg
+            # FFN with pre-norm and residual
+            h = h + self._ffn(blk["ff"], self._rms_norm_sh(blk["ff_norm"]["w"], h))
+            h = lg.halo_exchange(h)
+
+        h = self._rms_norm_sh(params["norm"]["w"], h)
+        s = h[:, 0, :]
+        e = _linear(params["energy_head"]["lin2"],
+                    jax.nn.silu(_linear(params["energy_head"]["lin1"],
+                                        s.astype(positions.dtype))))[:, 0]
+        return e + params["species_ref"]["w"][z].astype(positions.dtype)

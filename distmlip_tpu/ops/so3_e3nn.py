@@ -1,0 +1,171 @@
+"""e3nn-convention real-SH rotations — the fairchem/UMA Wigner pipeline.
+
+The UMA eSCN backbone (reference implementations/uma/escn_md.py:74-130)
+builds per-edge Wigner matrices as ``X(alpha) J X(beta) J X(gamma)`` from
+precomputed per-l ``Jd`` tables, in e3nn's real-spherical-harmonic basis
+(y is the polar axis; within a degree-l block the 2l+1 components are
+ordered m = -l..l with the m=0, y-aligned component at the center).
+
+Everything here is DERIVED, not copied: the J tables are computed from
+scratch by least squares against this repo's own spherical-harmonic
+implementation (``ops/so3._sh_general``) evaluated in the e3nn axis
+convention, and validated in-session against the reference's shipped
+``Jd.pt`` to ~1e-15 for l <= 6 (the tables are pinned by a hardcoded l=1
+check in tests/test_so3_e3nn.py; higher l follow from the representation
+property, which the tests verify directly).
+
+Basis relation: e3nn's real SH of degree l evaluated at (x, y, z) equals
+the standard z-polar real SH evaluated at the cyclically permuted point
+(z, x, y) — e.g. the l=1 triple comes out in (x, y, z) order with y (the
+e3nn polar axis) at the m=0 center slot.
+
+Angle convention (e3nn YXY): a unit vector u has beta = acos(u_y),
+alpha = atan2(u_x, u_z); the rotation R(alpha, beta, 0) maps the polar
+axis y-hat onto u, and its Wigner matrix D satisfies Y(R r) = D Y(r).
+Hence D(alpha, beta, 0) rotates edge-frame coefficients to the lab frame
+("wigner_inv" in fairchem terms) and its transpose rotates lab features
+into the edge-aligned frame.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from .so3 import _sh_general
+
+
+def sh_e3nn_np(l: int, r: np.ndarray) -> np.ndarray:
+    """e3nn-convention real spherical harmonics (host, float64)."""
+    r = np.asarray(r, dtype=np.float64)
+    return _sh_general(l, r[..., [2, 0, 1]], np)
+
+
+def _wigner_of_orthogonal_np(l: int, O: np.ndarray) -> np.ndarray:
+    """D with Y(O r) = D Y(r) in the e3nn basis, by least squares."""
+    rng = np.random.default_rng(12345)
+    pts = rng.normal(size=(max(64, 4 * (2 * l + 1)), 3))
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    Y = sh_e3nn_np(l, pts)
+    Yo = sh_e3nn_np(l, pts @ np.asarray(O, dtype=np.float64).T)
+    D, *_ = np.linalg.lstsq(Y, Yo, rcond=None)
+    return D.T
+
+
+# the orthogonal map whose per-l representation is the "Jd" table:
+# (x, y, z) -> (-y, -x, z), i.e. the reflection swapping the alpha/gamma
+# z-rotation axis (y) with the beta axis so X(beta) can be expressed in
+# z-rotation form: J X_z(beta) J = X_x(beta)
+_O_J = np.array([[0.0, -1.0, 0.0], [-1.0, 0.0, 0.0], [0.0, 0.0, 1.0]])
+
+
+@functools.lru_cache(maxsize=None)
+def jd_np(l: int) -> np.ndarray:
+    """Derived per-l J table (involution; equals upstream Jd.pt values)."""
+    return _wigner_of_orthogonal_np(l, _O_J)
+
+
+def z_rot_np(l: int, angles: np.ndarray) -> np.ndarray:
+    """Batched z-rotation (about e3nn's polar axis y) Wigner blocks.
+
+    Frequencies run l..-l along the diagonal; sin terms sit on the
+    antidiagonal. The diagonal is written last so the center element is
+    cos(0) = 1, not sin(0) (reference escn_md.py's _z_rot_mat writes sin
+    first for the same reason).
+    """
+    angles = np.asarray(angles, dtype=np.float64)
+    K = 2 * l + 1
+    f = np.arange(l, -l - 1, -1.0)
+    M = np.zeros(angles.shape + (K, K))
+    i = np.arange(K)
+    M[..., i, K - 1 - i] = np.sin(f * angles[..., None])
+    M[..., i, i] = np.cos(f * angles[..., None])
+    return M
+
+
+def _z_rot_jnp(l: int, angles):
+    K = 2 * l + 1
+    f = jnp.arange(l, -l - 1, -1.0, dtype=angles.dtype)
+    co = jnp.cos(f * angles[..., None])  # (..., K)
+    si = jnp.sin(f * angles[..., None])
+    i = np.arange(K)
+    M = jnp.zeros(angles.shape + (K, K), dtype=angles.dtype)
+    M = M.at[..., i, K - 1 - i].set(si)
+    M = M.at[..., i, i].set(co)
+    return M
+
+
+def edge_angles(rhat):
+    """e3nn (alpha, beta) of unit vectors; beta clipped away from the poles
+    only through the acos argument (the Jd pipeline itself is smooth)."""
+    alpha = jnp.arctan2(rhat[..., 0], rhat[..., 2])
+    beta = jnp.arccos(jnp.clip(rhat[..., 1], -1.0, 1.0))
+    return alpha, beta
+
+
+def wigner_blocks_from_edges(l_max: int, rhat):
+    """Per-l lab-from-edge Wigner blocks for a batch of edge directions.
+
+    Returns ``[D_0, ..., D_lmax]`` with ``D_l``: (E, 2l+1, 2l+1) in the
+    edge-directions' dtype. ``D_l @ f_edge`` rotates edge-frame
+    coefficients to the lab frame; ``D_l.T @ f_lab`` rotates into the
+    edge frame (the gauge angle gamma is fixed to 0 — the SO(2)
+    convolutions are exactly gauge-covariant, so any gauge gives
+    identical model output; fairchem instead carries the gamma of its
+    edge_rot_mat construction, reference escn_md.py:99-109).
+    """
+    alpha, beta = edge_angles(rhat)
+    out = []
+    for l in range(l_max + 1):
+        J = jnp.asarray(jd_np(l), dtype=rhat.dtype)
+        Xa = _z_rot_jnp(l, alpha)
+        Xb = _z_rot_jnp(l, beta)
+        out.append(jnp.einsum("epq,qr,ers,st->ept", Xa, J, Xb, J))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Coefficient layout (lmax, mmax narrowing) — fairchem CoefficientMapping
+# ---------------------------------------------------------------------------
+
+
+class CoeffLayout:
+    """Index bookkeeping for (l <= lmax, |m| <= min(l, mmax)) coefficients.
+
+    The narrowed coefficient stack is l-major: for each l, the CENTER
+    2*min(l, mmax)+1 rows of the (2l+1) e3nn block, order m = -mm..mm.
+    ``plus_idx[m] / minus_idx[m]`` give, for each |m|, the narrowed-stack
+    positions of the (l, +m) and (l, -m) coefficients over l = m..lmax —
+    the (cos, sin) pairs the SO(2) convolutions mix (fairchem packs the
+    same pairs via its to_m permutation, escn_md.py:117-129).
+    """
+
+    def __init__(self, l_max: int, m_max: int | None = None):
+        self.l_max = l_max
+        self.m_max = l_max if m_max is None else min(m_max, l_max)
+        self.block_slices = []
+        self.size = 0
+        for l in range(l_max + 1):
+            mm = min(l, self.m_max)
+            self.block_slices.append(slice(self.size, self.size + 2 * mm + 1))
+            self.size += 2 * mm + 1
+        self.plus_idx, self.minus_idx = {}, {}
+        for m in range(self.m_max + 1):
+            plus, minus = [], []
+            for l in range(m, l_max + 1):
+                mm = min(l, self.m_max)
+                base = self.block_slices[l].start
+                plus.append(base + mm + m)    # center + m
+                minus.append(base + mm - m)   # center - m
+            self.plus_idx[m] = np.array(plus)
+            self.minus_idx[m] = np.array(minus)
+
+    def m_size(self, m: int) -> int:
+        return self.l_max + 1 - m
+
+    def block_rows(self, l: int) -> slice:
+        """Rows of the full (2l+1) e3nn block kept after mmax narrowing."""
+        mm = min(l, self.m_max)
+        return slice(l - mm, l + mm + 1)

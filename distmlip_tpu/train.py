@@ -69,3 +69,104 @@ def make_train_step(model_energy_fn, mesh, optimizer, w_energy=1.0, w_force=1.0,
         return params, opt_state, loss
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Multi-structure batching (stacked graphs under one capacity bucket)
+# ---------------------------------------------------------------------------
+
+
+def stack_graphs(graphs):
+    """Stack same-capacity PartitionedGraphs into one batched pytree.
+
+    All graphs must share capacities (build them with one CapacityPolicy —
+    the sticky buckets make equal shapes the common case) and the same
+    partition count. The batch axis is leading; use with
+    ``make_batched_train_step`` / ``make_eval_fn``, which vmap the whole
+    sharded program over it (the same one-program batching the stacked
+    ensembles use, calculators/ensemble.py).
+    """
+    import numpy as np
+
+    # compare the FULL leaf-shape signature (node, edge, bond, halo
+    # capacities all matter, not just positions) so mismatches surface as
+    # this actionable message, not a raw tree-structure error from stack
+    sigs = {tuple(np.shape(x) for x in jax.tree.leaves(g)) for g in graphs}
+    if len(sigs) != 1:
+        raise ValueError(
+            "graphs have mixed array shapes (different capacity buckets); "
+            "build them with a shared CapacityPolicy so they land in one "
+            f"bucket: {sorted(sigs)[:2]} ...")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *graphs)
+
+
+def stack_targets(targets):
+    """Stack per-structure target dicts along a leading batch axis."""
+    return jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                        *targets)
+
+
+def make_batched_train_step(model_energy_fn, mesh, optimizer, w_energy=1.0,
+                            w_force=1.0, w_stress=0.0):
+    """Train step over a BATCH of structures: the per-structure loss is
+    vmapped over the stacked graphs and averaged, so one jitted program
+    moves the whole minibatch per step.
+
+    Returns step(params, opt_state, graphs, positions, targets) ->
+    (params, opt_state, loss) with graphs/positions/targets stacked by
+    ``stack_graphs`` / ``stack_targets``.
+    """
+    loss_fn = make_loss_fn(model_energy_fn, mesh, w_energy, w_force, w_stress)
+
+    def batch_loss(params, graphs, positions, targets):
+        per = jax.vmap(loss_fn, in_axes=(None, 0, 0, 0))(
+            params, graphs, positions, targets)
+        return jnp.mean(per)
+
+    @jax.jit
+    def step(params, opt_state, graphs, positions, targets):
+        loss, grads = jax.value_and_grad(batch_loss)(
+            params, graphs, positions, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_eval_fn(model_energy_fn, mesh, w_energy=1.0, w_force=1.0,
+                 w_stress=0.0):
+    """Held-out evaluation: (params, graphs, positions, targets) -> mean
+    loss over a stacked validation batch (no gradient, same loss weights)."""
+    loss_fn = make_loss_fn(model_energy_fn, mesh, w_energy, w_force, w_stress)
+
+    @jax.jit
+    def evaluate(params, graphs, positions, targets):
+        per = jax.vmap(loss_fn, in_axes=(None, 0, 0, 0))(
+            params, graphs, positions, targets)
+        return jnp.mean(per)
+
+    return evaluate
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint/resume for training runs (params + optimizer state + step)
+# ---------------------------------------------------------------------------
+
+
+def save_train_state(path: str, params, opt_state, step: int) -> None:
+    """One npz with the full resumable state (utils/checkpoint format)."""
+    from .utils.checkpoint import save_params
+
+    save_params(path, {"params": params, "opt_state": opt_state,
+                       "step": jnp.asarray(step)})
+
+
+def load_train_state(path: str, params_like, opt_state_like):
+    """Restore (params, opt_state, step) saved by save_train_state."""
+    from .utils.checkpoint import load_params
+
+    state = load_params(path, like={"params": params_like,
+                                    "opt_state": opt_state_like,
+                                    "step": jnp.asarray(0)})
+    return state["params"], state["opt_state"], int(state["step"])

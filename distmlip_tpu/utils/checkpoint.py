@@ -81,7 +81,12 @@ def load_params(path: str, like=None, *, allow_legacy_layout: bool = False):
             return {k: rebuild(v, f"{prefix}{k}/") for k, v in template.items()}
         if isinstance(template, (list, tuple)):
             seq = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(template)]
-            return type(template)(seq) if isinstance(template, tuple) else seq
+            if isinstance(template, tuple):
+                # NamedTuples (e.g. optax optimizer states) construct from
+                # positional fields, plain tuples from one iterable
+                return (type(template)(*seq) if hasattr(template, "_fields")
+                        else tuple(seq))
+            return seq
         if template is None:
             return None  # None leaves are not saved (empty subtrees)
         key = prefix[:-1]

@@ -32,6 +32,15 @@ from distmlip_tpu.calculators import Atoms, DistPotential
 from distmlip_tpu.models import TensorNet, TensorNetConfig
 
 
+def _print_hbm():
+    """Peak device memory (BASELINE.md ladder asks for a memory proof)."""
+    stats = jax.local_devices()[0].memory_stats() or {}
+    peak = stats.get("peak_bytes_in_use")
+    if peak is not None:
+        print(f"peak HBM: {peak / 2**30:.2f} GiB "
+              f"(in use {stats.get('bytes_in_use', 0) / 2**30:.2f} GiB)")
+
+
 def compare_partitions(tag, model, params, atoms, smap, P, tol_de, tol_df):
     """P-way vs 1-way energy/forces compare — the ladder's shared check."""
     results = {}
@@ -116,6 +125,7 @@ def config3():
             print(f"single-chip {tag}: E={res['energy']:.2f} "
                   f"{time.time() - t0:.2f}s "
                   f"({len(atoms) / (time.time() - t0):.0f} atoms/s)")
+        _print_hbm()
         return
 
     cfg = MACEConfig(num_species=2, channels=32, l_max=2, a_lmax=2,
@@ -165,6 +175,7 @@ def config4():
             pot.calculate(atoms)
             print(f"single-chip {tag}: {time.time() - t0:.2f}s "
                   f"({len(atoms) / (time.time() - t0):.0f} atoms/s)")
+        _print_hbm()
         return
 
     cfg = ESCNConfig(num_species=8, channels=32, l_max=2, num_layers=2,

@@ -164,10 +164,34 @@ def test_ensemble_potential(rng):
     np.testing.assert_allclose(res["energy"], res["energies"].mean())
 
 
-def test_relaxer_lbfgs(rng, potential):
+@pytest.mark.parametrize("optimizer", ["lbfgs", "bfgs", "mdmin", "cg"])
+def test_relaxer_optimizers_converge(rng, potential, optimizer):
+    """Every optimizer in the enum (reference ase.py:40-50 analogue) must
+    drive the same perturbed crystal below fmax."""
     atoms = make_atoms(rng, noise=0.12)
-    out = Relaxer(potential, optimizer="lbfgs", fmax=0.05).relax(atoms, steps=200)
+    out = Relaxer(potential, optimizer=optimizer, fmax=0.05).relax(
+        atoms, steps=300)
     assert out.converged and np.abs(out.forces).max() < 0.05
+
+
+def test_relaxer_exp_cell_filter(rng, potential):
+    """Exp cell filter (ASE ExpCellFilter analogue): strained cell relaxes
+    with the exponential-map parameterization, reducing the stress."""
+    atoms = make_atoms(rng, noise=0.05)
+    atoms.cell *= 1.03
+    atoms.positions *= 1.03
+    res0 = potential.calculate(atoms)
+    out = Relaxer(potential, relax_cell=True, cell_filter="exp", fmax=0.08,
+                  smax=0.01).relax(atoms, steps=300)
+    assert np.abs(out.forces).max() < 0.08
+    assert np.abs(out.stress).max() <= np.abs(res0["stress"]).max() + 1e-6
+
+
+def test_relaxer_rejects_unknown_optimizer(potential):
+    with pytest.raises(ValueError):
+        Relaxer(potential, optimizer="nope")
+    with pytest.raises(ValueError):
+        Relaxer(potential, cell_filter="nope")
 
 
 def test_stacked_ensemble_matches_sequential(rng):

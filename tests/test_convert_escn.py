@@ -1,0 +1,408 @@
+"""MAPPINGS["escn"] golden contract: a float64, explicit-loop torch oracle
+implementing the fairchem eSCNMDBackbone parameterization (key names and
+shapes as a real UMA-family ``state_dict()``), converted through
+``from_torch("escn", ...)`` and evaluated by ESCNMD — energies and forces
+must agree to <= 1e-6 (both sides float64). The oracle is written
+independently of the JAX model (plain tensor ops, explicit per-l/per-m
+loops, torch autograd forces); the shared ingredient is the derived Jd
+table, which tests/test_so3_e3nn.py pins by property and an upstream-
+convention anchor.
+
+Covers VERDICT r3 next-round item 3: zero-unmapped conversion of a
+UMA-shaped synthetic dict + oracle parity, closing the last model family
+without a converter (reference implementations/uma/escn_md.py:559-569).
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+
+from distmlip_tpu.models import ESCNMD, ESCNMDConfig
+from distmlip_tpu.models.convert import from_torch
+from distmlip_tpu.ops.so3_e3nn import CoeffLayout, jd_np
+
+pytestmark = pytest.mark.slow
+
+torch.manual_seed(0)
+
+Z, C, H, CE, DB, NL = 5, 8, 8, 6, 10, 2
+LMAX, MMAX = 3, 2
+CUT, AVG = 3.5, 9.0
+NQ, NS, ND = 7, 4, 3
+CFG = ESCNMDConfig(
+    max_num_elements=Z, sphere_channels=C, lmax=LMAX, mmax=MMAX,
+    num_layers=NL, hidden_channels=H, edge_channels=CE,
+    num_distance_basis=DB, cutoff=CUT, avg_degree=AVG,
+    num_charges=NQ, charge_min=-3, num_spins=NS, num_datasets=ND,
+    edge_chunk=0,
+)
+DX = DB + 2 * CE
+LAY = CoeffLayout(LMAX, MMAX)
+
+
+def _lin(sd, name, d_out, d_in, bias=True):
+    sd[name + ".weight"] = torch.randn(d_out, d_in, dtype=torch.float64) / np.sqrt(d_in)
+    if bias:
+        sd[name + ".bias"] = torch.randn(d_out, dtype=torch.float64) * 0.1
+
+
+def _rad(sd, prefix, d_in, d_hidden, d_out):
+    _lin(sd, prefix + ".net.0", d_hidden, d_in)
+    sd[prefix + ".net.1.weight"] = 1.0 + 0.1 * torch.randn(d_hidden, dtype=torch.float64)
+    sd[prefix + ".net.1.bias"] = 0.1 * torch.randn(d_hidden, dtype=torch.float64)
+    _lin(sd, prefix + ".net.3", d_out, d_hidden)
+
+
+def synthetic_escn_state_dict():
+    """A UMA/eSCNMD-shaped state dict (fairchem key names, random values)."""
+    sd = {}
+    sd["backbone.sphere_embedding.weight"] = torch.randn(Z, C, dtype=torch.float64)
+    sd["backbone.source_embedding.weight"] = torch.randn(Z, CE, dtype=torch.float64)
+    sd["backbone.target_embedding.weight"] = torch.randn(Z, CE, dtype=torch.float64)
+    sd["backbone.csd_embedding.charge_embedding.weight"] = torch.randn(NQ, C, dtype=torch.float64)
+    sd["backbone.csd_embedding.spin_embedding.weight"] = torch.randn(NS, C, dtype=torch.float64)
+    sd["backbone.csd_embedding.dataset_embedding.weight"] = torch.randn(ND, C, dtype=torch.float64)
+    _lin(sd, "backbone.csd_embedding.mix_csd", C, 3 * C)
+    sd["backbone.distance_expansion.offset"] = torch.linspace(0.0, CUT, DB, dtype=torch.float64)
+    _rad(sd, "backbone.edge_degree_embedding.rad_func", DX, CE, (LMAX + 1) * C)
+    for i in range(NL):
+        bp = f"backbone.blocks.{i}"
+        sd[bp + ".norm_1.affine_weight"] = 1.0 + 0.1 * torch.randn(LMAX + 1, C, dtype=torch.float64)
+        # so2_conv_1: in 2C, out H, extra gate scalars LMAX*H
+        rad_len = sum(LAY.m_size(m) for m in range(MMAX + 1)) * 2 * C
+        _rad(sd, bp + ".so2_conv_1.rad_func", DX, CE, rad_len)
+        m0_in, m0_out = LAY.m_size(0) * 2 * C, LAY.m_size(0) * H + LMAX * H
+        _lin(sd, bp + ".so2_conv_1.fc_m0", m0_out, m0_in)
+        for m in range(1, MMAX + 1):
+            nl = LAY.m_size(m)
+            _lin(sd, f"{bp}.so2_conv_1.so2_m_conv.{m - 1}.fc",
+                 2 * nl * H, nl * 2 * C, bias=False)
+        # so2_conv_2: in H, out C, internal weights
+        _lin(sd, bp + ".so2_conv_2.fc_m0", LAY.m_size(0) * C, LAY.m_size(0) * H)
+        for m in range(1, MMAX + 1):
+            nl = LAY.m_size(m)
+            _lin(sd, f"{bp}.so2_conv_2.so2_m_conv.{m - 1}.fc",
+                 2 * nl * C, nl * H, bias=False)
+        sd[bp + ".ff_norm.affine_weight"] = 1.0 + 0.1 * torch.randn(LMAX + 1, C, dtype=torch.float64)
+        sd[bp + ".ff.so3_linear_1.weight"] = torch.randn(LMAX + 1, H, C, dtype=torch.float64) / np.sqrt(C)
+        sd[bp + ".ff.so3_linear_1.bias"] = 0.1 * torch.randn(H, dtype=torch.float64)
+        _lin(sd, bp + ".ff.gating_linear", LMAX * H, C)
+        sd[bp + ".ff.so3_linear_2.weight"] = torch.randn(LMAX + 1, C, H, dtype=torch.float64) / np.sqrt(H)
+        sd[bp + ".ff.so3_linear_2.bias"] = 0.1 * torch.randn(C, dtype=torch.float64)
+    sd["backbone.norm.affine_weight"] = 1.0 + 0.1 * torch.randn(LMAX + 1, C, dtype=torch.float64)
+    _lin(sd, "heads.energy.mlp.0", C, C)
+    _lin(sd, "heads.energy.mlp.2", 1, C)
+    return sd
+
+
+# ---------------------------------------------------------------------------
+# The oracle: explicit loops, torch float64, fairchem parameterization
+# ---------------------------------------------------------------------------
+
+
+def _z_rot_t(l, ang):
+    K = 2 * l + 1
+    f = torch.arange(l, -l - 1, -1, dtype=torch.float64)
+    M = torch.zeros(ang.shape[0], K, K, dtype=torch.float64)
+    for i in range(K):
+        M[:, i, K - 1 - i] = torch.sin(f[i] * ang)
+    for i in range(K):
+        M[:, i, i] = torch.cos(f[i] * ang)
+    return M
+
+
+def _wigner_t(rhat):
+    """Per-l lab-from-edge Wigner blocks, e3nn Jd pipeline, gamma = 0."""
+    alpha = torch.atan2(rhat[:, 0], rhat[:, 2])
+    beta = torch.acos(torch.clamp(rhat[:, 1], -1.0, 1.0))
+    out = []
+    for l in range(LMAX + 1):
+        J = torch.as_tensor(jd_np(l), dtype=torch.float64)
+        out.append(_z_rot_t(l, alpha) @ J @ _z_rot_t(l, beta) @ J)
+    return out
+
+
+def _rms_norm_sh_t(w, x):
+    S = (LMAX + 1) ** 2
+    bal = torch.zeros(S, dtype=torch.float64)
+    o = 0
+    for l in range(LMAX + 1):
+        bal[o:o + 2 * l + 1] = 1.0 / ((2 * l + 1) * (LMAX + 1))
+        o += 2 * l + 1
+    ms = (x.pow(2) * bal[None, :, None]).sum(dim=1).mean(dim=1)
+    x = x * torch.rsqrt(ms + 1e-12)[:, None, None]
+    w_full = torch.repeat_interleave(
+        w, torch.tensor([2 * l + 1 for l in range(LMAX + 1)]), dim=0)
+    return x * w_full[None]
+
+
+def _rad_t(sd, prefix, x):
+    x = x @ sd[prefix + ".net.0.weight"].T + sd[prefix + ".net.0.bias"]
+    mu, var = x.mean(-1, keepdim=True), x.var(-1, keepdim=True, unbiased=False)
+    x = (x - mu) / torch.sqrt(var + 1e-5)
+    x = x * sd[prefix + ".net.1.weight"] + sd[prefix + ".net.1.bias"]
+    x = torch.nn.functional.silu(x)
+    return x @ sd[prefix + ".net.3.weight"].T + sd[prefix + ".net.3.bias"]
+
+
+def _rot_in_t(h_lab, D):
+    """(E, S_full, c) -> (E, S_nar, c): per-l transpose + center-row keep."""
+    parts = []
+    for l in range(LMAX + 1):
+        rows = LAY.block_rows(l)
+        Dl = D[l][:, :, rows]
+        parts.append(torch.einsum("epn,epc->enc", Dl, h_lab[:, l * l:l * l + 2 * l + 1]))
+    return torch.cat(parts, dim=1)
+
+
+def _rot_out_t(y, D):
+    parts = []
+    for l in range(LMAX + 1):
+        rows = LAY.block_rows(l)
+        Dl = D[l][:, :, rows]
+        parts.append(torch.einsum("epn,enc->epc", Dl, y[:, LAY.block_slices[l]]))
+    return torch.cat(parts, dim=1)
+
+
+def _mmajor_inv_perm():
+    """l-major position of each m-major row: scattering m-major results
+    back to the l-major stack is a pure gather by the inverse permutation
+    (keeps the oracle free of in-place writes for autograd)."""
+    order = list(LAY.plus_idx[0])
+    for m in range(1, MMAX + 1):
+        order += list(LAY.plus_idx[m]) + list(LAY.minus_idx[m])
+    inv = np.empty(LAY.size, dtype=np.int64)
+    inv[np.array(order)] = np.arange(LAY.size)
+    return torch.as_tensor(inv)
+
+
+def _so2_t(sd, prefix, fr, rad, c_in, c_out, extra_m0):
+    E = fr.shape[0]
+    parts = []   # m-major order: m0, then (+m, -m) per m
+    extra = None
+    off = 0
+    for m in range(MMAX + 1):
+        nl = LAY.m_size(m)
+        if m == 0:
+            f0 = fr[:, torch.as_tensor(LAY.plus_idx[0])].reshape(E, nl * c_in)
+            if rad is not None:
+                f0 = f0 * rad[:, off:off + nl * c_in]
+            out0 = f0 @ sd[prefix + ".fc_m0.weight"].T + sd[prefix + ".fc_m0.bias"]
+            main = out0[:, :nl * c_out]
+            if extra_m0:
+                extra = out0[:, nl * c_out:]
+            parts.append(main.reshape(E, nl, c_out))
+        else:
+            fp = fr[:, torch.as_tensor(LAY.plus_idx[m])].reshape(E, nl * c_in)
+            fm = fr[:, torch.as_tensor(LAY.minus_idx[m])].reshape(E, nl * c_in)
+            if rad is not None:
+                s = rad[:, off:off + nl * c_in]
+                fp, fm = fp * s, fm * s
+            W = sd[f"{prefix}.so2_m_conv.{m - 1}.fc.weight"]
+            Wr, Wi = W[:nl * c_out], W[nl * c_out:]
+            yp = fp @ Wr.T - fm @ Wi.T
+            ym = fm @ Wr.T + fp @ Wi.T
+            parts.append(yp.reshape(E, nl, c_out))
+            parts.append(ym.reshape(E, nl, c_out))
+        off += nl * c_in
+    y = torch.cat(parts, dim=1)[:, _mmajor_inv_perm()]
+    return (y, extra) if extra_m0 else y
+
+
+def _gate_t(x, gates, full_layout):
+    E = x.shape[0]
+    g = torch.sigmoid(gates.reshape(E, LMAX, -1))
+    counts = [(2 * l + 1) if full_layout else (2 * min(l, MMAX) + 1)
+              for l in range(1, LMAX + 1)]
+    g_exp = torch.repeat_interleave(g, torch.tensor(counts), dim=1)
+    return torch.cat([torch.nn.functional.silu(x[:, :1]),
+                      x[:, 1:] * g_exp], dim=1)
+
+
+def _envelope_t(d):
+    # ops/radial.polynomial_cutoff p=6 mirror
+    u = torch.clamp(d / CUT, max=1.0)
+    p = 6
+    val = (1.0 - (p + 1) * (p + 2) / 2 * u**p + p * (p + 2) * u**(p + 1)
+           - p * (p + 1) / 2 * u**(p + 2))
+    return torch.where(d < CUT, val, torch.zeros_like(val))
+
+
+def oracle_forward(sd, pos, species, src, dst, charge, spin, dataset):
+    """Explicit eSCNMD forward; returns total energy (torch scalar)."""
+    S = (LMAX + 1) ** 2
+    vec = pos[src] - pos[dst]      # fairchem convention (compute.py:169-173)
+    d = vec.norm(dim=1)
+    rhat = vec / d[:, None]
+    D = _wigner_t(rhat)
+    env = _envelope_t(d)
+    centers = torch.linspace(0.0, CUT, DB, dtype=torch.float64)
+    width = CUT / (DB - 1)
+    gauss = torch.exp(-0.5 * ((d[:, None] - centers) / width) ** 2)
+
+    zemb = sd["backbone.sphere_embedding.weight"][species]
+    csd_cat = torch.cat([
+        sd["backbone.csd_embedding.charge_embedding.weight"][charge],
+        sd["backbone.csd_embedding.spin_embedding.weight"][spin],
+        sd["backbone.csd_embedding.dataset_embedding.weight"][dataset],
+    ])
+    csd = csd_cat @ sd["backbone.csd_embedding.mix_csd.weight"].T + \
+        sd["backbone.csd_embedding.mix_csd.bias"]
+
+    N = pos.shape[0]
+    h = torch.cat([(zemb + csd[None])[:, None, :],
+                   torch.zeros(N, S - 1, C, dtype=torch.float64)], dim=1)
+
+    x_edge = torch.cat([gauss,
+                        sd["backbone.source_embedding.weight"][species[src]],
+                        sd["backbone.target_embedding.weight"][species[dst]]],
+                       dim=1)
+
+    # edge-degree embedding
+    w = _rad_t(sd, "backbone.edge_degree_embedding.rad_func", x_edge)
+    w = w.reshape(-1, LMAX + 1, C)
+    zeros_rest = torch.zeros(len(d), LAY.size - (LMAX + 1), C,
+                             dtype=torch.float64)
+    y = torch.cat([w, zeros_rest], dim=1)[:, _mmajor_inv_perm()]
+    msg = _rot_out_t(y, D) * env[:, None, None]
+    agg = torch.zeros(N, S, C, dtype=torch.float64)
+    agg.index_add_(0, dst, msg)
+    h = h + agg / AVG
+
+    for i in range(NL):
+        bp = f"backbone.blocks.{i}"
+        hn = _rms_norm_sh_t(sd[bp + ".norm_1.affine_weight"], h)
+        hn = torch.cat([hn[:, :1] + csd[None, None], hn[:, 1:]], dim=1)
+        rad = _rad_t(sd, bp + ".so2_conv_1.rad_func", x_edge)
+        fr = torch.cat([_rot_in_t(hn[src], D), _rot_in_t(hn[dst], D)], dim=2)
+        y1, gates = _so2_t(sd, bp + ".so2_conv_1", fr, rad, 2 * C, H,
+                           extra_m0=True)
+        y1 = _gate_t(y1, gates, full_layout=False)
+        y2 = _so2_t(sd, bp + ".so2_conv_2", y1, None, H, C, extra_m0=False)
+        msg = _rot_out_t(y2, D) * env[:, None, None]
+        agg = torch.zeros(N, S, C, dtype=torch.float64)
+        agg.index_add_(0, dst, msg)
+        h = h + agg / AVG
+        # FFN
+        xf = _rms_norm_sh_t(sd[bp + ".ff_norm.affine_weight"], h)
+        gates = xf[:, 0] @ sd[bp + ".ff.gating_linear.weight"].T + \
+            sd[bp + ".ff.gating_linear.bias"]
+        w1 = torch.repeat_interleave(
+            sd[bp + ".ff.so3_linear_1.weight"],
+            torch.tensor([2 * l + 1 for l in range(LMAX + 1)]), dim=0)
+        hf = torch.einsum("nsc,shc->nsh", xf, w1)
+        hf = torch.cat([hf[:, :1] + sd[bp + ".ff.so3_linear_1.bias"],
+                        hf[:, 1:]], dim=1)
+        hf = _gate_t(hf, gates, full_layout=True)
+        w2 = torch.repeat_interleave(
+            sd[bp + ".ff.so3_linear_2.weight"],
+            torch.tensor([2 * l + 1 for l in range(LMAX + 1)]), dim=0)
+        yf = torch.einsum("nsh,sch->nsc", hf, w2)
+        yf = torch.cat([yf[:, :1] + sd[bp + ".ff.so3_linear_2.bias"],
+                        yf[:, 1:]], dim=1)
+        h = h + yf
+
+    h = _rms_norm_sh_t(sd["backbone.norm.affine_weight"], h)
+    s = h[:, 0]
+    e = torch.nn.functional.silu(
+        s @ sd["heads.energy.mlp.0.weight"].T + sd["heads.energy.mlp.0.bias"])
+    e = e @ sd["heads.energy.mlp.2.weight"].T + sd["heads.energy.mlp.2.bias"]
+    return e.sum()
+
+
+def _cluster(rng, n=36, box=30.0, spread=5.5):
+    """Aperiodic cluster centered in a huge box: no wrap, no offsets —
+    the oracle's brute-force edge list matches the pipeline's exactly."""
+    cart = rng.normal(0.0, spread, (n, 3))
+    # enforce a minimum separation so the cluster is physical
+    for _ in range(40):
+        diff = cart[:, None] - cart[None, :]
+        dist = np.linalg.norm(diff, axis=-1) + np.eye(n) * 1e9
+        close = dist < 1.2
+        if not close.any():
+            break
+        push = np.where(close[..., None], diff * 0.2, 0.0).sum(axis=1)
+        cart = cart + push
+    cart = cart + box / 2
+    lattice = np.eye(3) * box
+    species = rng.integers(0, Z, n).astype(np.int32)
+    return cart, lattice, species
+
+
+@pytest.fixture(scope="module")
+def converted():
+    sd = synthetic_escn_state_dict()
+    model = ESCNMD(CFG)
+    params = model.init(jax.random.PRNGKey(0))
+    params, report = from_torch("escn", sd, params, model=model)
+    return sd, model, params, report
+
+
+def test_zero_unmapped(converted):
+    _, _, _, report = converted
+    assert report["unused_torch"] == []
+
+
+def test_energy_force_parity_vs_torch_oracle(converted):
+    sd, model, _, _ = converted
+    jax.config.update("jax_enable_x64", True)
+    try:
+        # init + convert UNDER x64: set_in casts checkpoint values to the
+        # leaf dtype, so float32-initialized leaves would round the weights
+        # and cap parity at ~1e-7
+        params = model.init(jax.random.PRNGKey(0))
+        params, _ = from_torch("escn", sd, params, model=model)
+        rng = np.random.default_rng(5)
+        cart, lattice, species = _cluster(rng)
+        charge, spin, dataset = 2, 1, 1
+
+        # oracle: brute-force directed edge list within the cutoff
+        n = len(cart)
+        diff = cart[:, None] - cart[None, :]
+        dist = np.linalg.norm(diff, axis=-1)
+        src, dst = np.nonzero((dist < CUT) & (dist > 0))
+        pos_t = torch.tensor(cart, dtype=torch.float64, requires_grad=True)
+        e_t = oracle_forward(sd, pos_t, torch.as_tensor(species, dtype=torch.long),
+                             torch.as_tensor(src), torch.as_tensor(dst),
+                             charge - CFG.charge_min, spin, dataset)
+        e_t.backward()
+        f_ref = -pos_t.grad.numpy()
+
+        from distmlip_tpu.neighbors import neighbor_list_numpy
+        from distmlip_tpu.parallel import make_potential_fn
+        from distmlip_tpu.partition import build_partitioned_graph, build_plan
+
+        nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], CUT)
+        plan = build_plan(nl, lattice, [1, 1, 1], 1, CUT, 0.0, False)
+        graph, host = build_partitioned_graph(
+            plan, nl, species, lattice, dtype=np.float64,
+            system={"charge": charge, "spin": spin, "dataset": dataset})
+        pot = make_potential_fn(model.energy_fn, None, compute_stress=False)
+        out = pot(params, graph, graph.positions)
+        e_j = float(out["energy"])
+        f_j = host.gather_owned(np.asarray(out["forces"]), n)
+
+        assert abs(e_j - float(e_t)) / n < 1e-9, (e_j, float(e_t))
+        np.testing.assert_allclose(f_j, f_ref, atol=1e-8)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_mole_shaped_dict_converts():
+    """Expert-stacked (K, out, in) SO(2) weights convert into a
+    num_experts=3 model with zero unmapped backbone tensors."""
+    K = 3
+    sd = synthetic_escn_state_dict()
+    for k in list(sd):
+        if ".so2_conv_" in k and (".fc_m0.weight" in k or ".fc.weight" in k):
+            sd[k] = torch.randn((K,) + tuple(sd[k].shape),
+                                dtype=torch.float64) / np.sqrt(sd[k].shape[-1])
+    cfg = ESCNMDConfig(**{**CFG.__dict__, "num_experts": K})
+    model = ESCNMD(cfg)
+    params = model.init(jax.random.PRNGKey(1))
+    params, report = from_torch("escn", sd, params, model=model, strict=False)
+    # every backbone tensor maps; only the (framework-side) MOLE gate has
+    # no fairchem analogue in the synthetic dict
+    assert report["unused_torch"] == []

@@ -1,0 +1,111 @@
+"""The e3nn-convention Wigner pipeline (ops/so3_e3nn) is pinned by
+properties, not by reference data: a hardcoded l=1 J table (the convention
+anchor — it fixes the axis ordering and signs), the representation
+property of the X(a) J X(b) J construction against direct least-squares
+Wigner matrices, and the edge-frame alignment property the eSCN SO(2)
+convolutions rely on. These must all hold for the UMA converter
+(MAPPINGS["escn"]) to be meaningful.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distmlip_tpu.ops.so3_e3nn import (
+    CoeffLayout,
+    _wigner_of_orthogonal_np,
+    edge_angles,
+    jd_np,
+    sh_e3nn_np,
+    wigner_blocks_from_edges,
+    z_rot_np,
+)
+
+
+def _rot_y(a):
+    c, s = np.cos(a), np.sin(a)
+    return np.array([[c, 0, s], [0, 1, 0], [-s, 0, c]])
+
+
+def _rot_x(a):
+    c, s = np.cos(a), np.sin(a)
+    return np.array([[1, 0, 0], [0, c, -s], [0, s, c]])
+
+
+def test_jd_l1_matches_upstream_convention():
+    # the anchor: fairchem/e3nn's Jd[1] in the (x, y, z) block order —
+    # (x, y, z) -> (-y, -x, z)
+    expected = np.array([[0.0, -1.0, 0.0], [-1.0, 0.0, 0.0], [0.0, 0.0, 1.0]])
+    np.testing.assert_allclose(jd_np(1), expected, atol=1e-14)
+
+
+@pytest.mark.parametrize("l", range(7))
+def test_jd_is_an_involution(l):
+    J = jd_np(l)
+    np.testing.assert_allclose(J @ J, np.eye(2 * l + 1), atol=1e-12)
+
+
+def test_xjxbjx_equals_direct_wigner():
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        al, be, ga = rng.uniform(0, np.pi, 3) * np.array([2, 1, 2])
+        R = _rot_y(al) @ _rot_x(be) @ _rot_y(ga)
+        for l in range(7):
+            D_direct = _wigner_of_orthogonal_np(l, R)
+            J = jd_np(l)
+            D_jd = (z_rot_np(l, np.array(al)) @ J @ z_rot_np(l, np.array(be))
+                    @ J @ z_rot_np(l, np.array(ga)))
+            np.testing.assert_allclose(D_jd, D_direct, atol=1e-12)
+
+
+def test_edge_frame_alignment():
+    """D(alpha, beta, 0) maps Y(y-hat) to Y(u): edge-frame coefficients
+    rotate to the lab frame, so the m=0 slot is the edge-aligned one."""
+    rng = np.random.default_rng(3)
+    u = rng.normal(size=(8, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    blocks = wigner_blocks_from_edges(4, jnp.asarray(u, jnp.float32))
+    yhat = np.array([0.0, 1.0, 0.0])
+    for l in range(5):
+        D = np.asarray(blocks[l], dtype=np.float64)
+        Yu = sh_e3nn_np(l, u)
+        Yy = sh_e3nn_np(l, yhat)
+        np.testing.assert_allclose(D @ Yy, Yu, atol=1e-5)
+
+
+def test_wigner_blocks_orthogonal():
+    rng = np.random.default_rng(11)
+    u = rng.normal(size=(5, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    for l, D in enumerate(wigner_blocks_from_edges(3, jnp.asarray(u, jnp.float32))):
+        eye = np.eye(2 * l + 1)
+        for e in range(len(u)):
+            np.testing.assert_allclose(
+                np.asarray(D[e]) @ np.asarray(D[e]).T, eye, atol=1e-5)
+
+
+def test_edge_angles_poles_are_finite():
+    u = jnp.asarray([[0.0, 1.0, 0.0], [0.0, -1.0, 0.0]], jnp.float32)
+    al, be = edge_angles(u)
+    assert np.all(np.isfinite(np.asarray(al)))
+    np.testing.assert_allclose(np.asarray(be), [0.0, np.pi], atol=1e-6)
+
+
+def test_coeff_layout_narrowing():
+    lay = CoeffLayout(l_max=4, m_max=2)
+    # sizes: l=0:1, l=1:3, l>=2: 5 each
+    assert lay.size == 1 + 3 + 5 + 5 + 5
+    assert lay.m_size(0) == 5 and lay.m_size(2) == 3
+    # m=0 rows are each block's center
+    centers = [lay.block_slices[l].start + min(l, 2) for l in range(5)]
+    np.testing.assert_array_equal(lay.plus_idx[0], centers)
+    np.testing.assert_array_equal(lay.minus_idx[0], centers)
+    # +m / -m are symmetric about the center
+    for m in (1, 2):
+        np.testing.assert_array_equal(
+            lay.plus_idx[m] + lay.minus_idx[m],
+            2 * np.array([lay.block_slices[l].start + min(l, 2)
+                          for l in range(m, 5)]))
+    # full-block row narrowing
+    assert lay.block_rows(1) == slice(0, 3)
+    assert lay.block_rows(4) == slice(2, 7)

@@ -26,5 +26,7 @@ print('OK', d[0].platform, d[0].device_kind, float((x @ x).sum()))
     echo "$ts $out" > /tmp/tpu_up
     exit 0
   fi
-  if [ "$rc" -eq 124 ]; then sleep 1200; else sleep 180; fi
+  # a timeout-killed probe renews the server-side lease wedge, so after
+  # one back off HARD (40 min) to give the lease room to expire
+  if [ "$rc" -eq 124 ]; then sleep 2400; else sleep 180; fi
 done

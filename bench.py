@@ -206,11 +206,17 @@ def _main_measured():
     # MACE-MP-0-medium-faithful configuration (the BASELINE.md north-star
     # model): a_lmax = l_max = 3 per PARITY.md — benching a smaller a_lmax
     # would inflate atoms/s by shrinking the CG path set
+    # BENCH_REMAT: "1" full remat (default), "0" none, or a checkpoint
+    # policy name ("dots" keeps GEMM outputs resident in the backward)
+    remat_env = os.environ.get("BENCH_REMAT", "1")
+    remat = {"1": True, "0": False}.get(remat_env, remat_env)
     cfg = MACEConfig(
         num_species=95, channels=128, l_max=3,
         a_lmax=int(os.environ.get("BENCH_A_LMAX", "3")), hidden_lmax=1,
         correlation=3, num_interactions=2, num_bessel=8, radial_mlp=64,
-        cutoff=5.0, avg_num_neighbors=14.0,
+        cutoff=5.0, avg_num_neighbors=14.0, remat=remat,
+        edge_chunk=int(os.environ.get("BENCH_EDGE_CHUNK", "32768")),
+        node_chunk=int(os.environ.get("BENCH_NODE_CHUNK", "4096")),
     )
     model = MACE(cfg)
     params = model.init(jax.random.PRNGKey(0))

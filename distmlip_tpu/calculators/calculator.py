@@ -98,25 +98,59 @@ class DistPotential:
         self.partition_grid = (
             tuple(int(g) for g in partition_grid) if partition_grid else None
         )
-        self.num_partitions = num_partitions or len(devices)
-        self.mesh = (
-            graph_mesh(self.num_partitions, devices) if self.num_partitions > 1 else None
-        )
+        self._devices = devices
         self.species_map = species_map
         self.num_threads = num_threads
         self.caps = caps or CapacityPolicy()
         self.cutoff = float(model.cfg.cutoff)
         self.bond_cutoff = float(getattr(model.cfg, "bond_cutoff", 0.0))
         self.use_bond_graph = bool(getattr(model.cfg, "use_bond_graph", False))
-        self._potential = make_potential_fn(
-            model.energy_fn, self.mesh, compute_stress=compute_stress
-        )
         self.compute_stress = bool(compute_stress)
         self.skin = float(skin)
+        # default num_partitions is AUTO: all devices, clamped by the slab
+        # rule (box extent / partition > 2 * build cutoff) for the first
+        # structure seen — an explicit num_partitions/partition_grid is
+        # taken verbatim. Resolution is deferred to the first build because
+        # the cell is not known here.
+        self.num_partitions = num_partitions
+        self.mesh = None
+        self._potential = None
+        if self.num_partitions is not None:
+            self._init_runtime()
         self._cache = None  # (graph, host, positions_sharding, build_pos,
                             #  numbers, cell, pbc, system)
         self.last_timings: dict[str, float] = {}
         self.rebuild_count = 0
+
+    def _init_runtime(self):
+        self.mesh = (
+            graph_mesh(self.num_partitions, self._devices)
+            if self.num_partitions > 1 else None
+        )
+        self._potential = make_potential_fn(
+            self.model.energy_fn, self.mesh, compute_stress=self.compute_stress
+        )
+
+    def _auto_partition_count(self, atoms: Atoms) -> int:
+        """All devices, clamped so the planner's slab width stays above 2x
+        the build cutoff (the one-destination halo invariant; thinner slabs
+        raise PartitionError). Mirrors the planner's geometry exactly:
+        slab axis = longest PERIODIC lattice vector (partitioner
+        choose_axis), width measured as plane spacing (skew-safe), not row
+        norm."""
+        from .. import geometry
+        from ..partition.partitioner import choose_axis
+
+        r_build = self.cutoff + self.skin
+        if self.use_bond_graph:
+            r_build = max(r_build, self.bond_cutoff + self.skin)
+        pbc = np.asarray(atoms.pbc, dtype=bool)
+        if not pbc.any():
+            return 1
+        axis = choose_axis(atoms.cell, pbc)
+        spacing = geometry.plane_spacings(atoms.cell)[axis]
+        p_geom = int(spacing / (2.0 * r_build + 1e-9))
+        return max(1, min(len(self._devices), p_geom))
 
     def _species(self, numbers: np.ndarray) -> np.ndarray:
         if self.species_map is None:
@@ -171,9 +205,20 @@ class DistPotential:
             is_leaf=lambda x: isinstance(x, PartitionSpec),
         )
 
+    def ensure_runtime(self, atoms: Atoms) -> None:
+        """Resolve AUTO partitioning (num_partitions=None) against this
+        structure's cell and build the mesh + jitted potential. Called
+        implicitly on first use; callers that read ``mesh``/
+        ``num_partitions`` before calculating (DeviceMD, partition_report)
+        call it explicitly."""
+        if self.num_partitions is None:
+            self.num_partitions = self._auto_partition_count(atoms)
+            self._init_runtime()
+
     def _build_graph(self, atoms: Atoms):
         import jax
 
+        self.ensure_runtime(atoms)
         r_build = self.cutoff + self.skin
         b_build = (self.bond_cutoff + self.skin) if self.use_bond_graph else 0.0
         nl = neighbor_list(
@@ -253,10 +298,12 @@ class DistPotential:
 
     def partition_report(self, atoms: Atoms) -> str:
         """Partition-balance diagnostics (reference dist.py:704-721)."""
+        self.ensure_runtime(atoms)
         nl = neighbor_list(atoms.positions, atoms.cell, atoms.pbc, self.cutoff,
                            bond_r=self.bond_cutoff if self.use_bond_graph else 0.0)
         plan = build_plan(nl, atoms.cell, atoms.pbc, self.num_partitions,
-                          self.cutoff, self.bond_cutoff, self.use_bond_graph)
+                          self.cutoff, self.bond_cutoff, self.use_bond_graph,
+                          grid=self.partition_grid)
         return plan.summary()
 
 
@@ -352,7 +399,8 @@ class EnsemblePotential:
             self.stacked_params = jax.tree.map(
                 lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *params_list
             )
-            self._vpot = jax.vmap(base._potential, in_axes=(0, None, None))
+            self._vpot = None  # built lazily: AUTO partitioning defers
+            #                    base._potential until the first cell is seen
         else:
             self.members = [base] + [
                 DistPotential(model, p, **kwargs) for p in params_list[1:]
@@ -362,6 +410,10 @@ class EnsemblePotential:
         if self.stacked:
             base = self.members[0]
             graph, host, positions = base._prepare(atoms)
+            if self._vpot is None:
+                import jax
+
+                self._vpot = jax.vmap(base._potential, in_axes=(0, None, None))
             t2 = time.perf_counter()
             out = self._vpot(self.stacked_params, graph, positions)
             energies = np.asarray(out["energy"], dtype=np.float64)

@@ -126,6 +126,7 @@ class DeviceMD:
 
         if potential.skin <= 0.0:
             raise ValueError("DeviceMD requires DistPotential(skin > 0)")
+        potential.ensure_runtime(atoms)  # AUTO partitioning needs the cell
         self.pot = potential
         self.atoms = atoms
         self.dt = float(timestep)

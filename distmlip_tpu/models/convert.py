@@ -872,7 +872,10 @@ def escn_mapping(params, sd, model=None):
     p = "backbone." if any(k.startswith("backbone.") for k in sd) else ""
     cfg = model.cfg if model is not None else None
     n_blocks = len(params["blocks"])
-    m_max = (cfg.mmax if cfg is not None
+    # ESCNMD clamps m_max = min(mmax, lmax) (CoeffLayout); the rules must
+    # match or a config with mmax > lmax would demand m-weights no
+    # checkpoint (or params tree) carries
+    m_max = (min(cfg.mmax, cfg.lmax) if cfg is not None
              else len([k for k in sd
                        if f"{p}blocks.0.so2_conv_1.so2_m_conv." in k
                        and k.endswith(".fc.weight")]))

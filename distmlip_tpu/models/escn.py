@@ -65,7 +65,9 @@ class ESCNConfig:
                                 # of system size (0 disables chunking). At
                                 # UMA-real l_max=6, S=49: unchunked 1M-edge
                                 # systems would need >100 GB for these alone.
-    remat: bool = True          # rematerialize each chunk in the backward pass
+    remat: bool | str = True    # rematerialize each chunk in the backward
+                                # pass (bool or checkpoint-policy name,
+                                # ops/chunk.remat_wrap)
     dtype: str = "float32"
 
     @property

@@ -34,6 +34,7 @@ Layout is channels-LAST (C in the TPU lane axis) per the round-3 finding.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +43,7 @@ import numpy as np
 from ..ops import radial
 from ..ops.nn import cast_params_subtrees
 from ..ops.segment import masked_segment_sum
-from ..ops.so3_e3nn import CoeffLayout, jd_np, _z_rot_jnp, edge_angles
+from ..ops.so3_e3nn import CoeffLayout, wigner_blocks_from_edges
 
 
 @dataclass(frozen=True)
@@ -65,7 +66,7 @@ class ESCNMDConfig:
     num_datasets: int = 4
     use_envelope: bool = True       # smooth cutoff on messages + edge-degree
     edge_chunk: int = 32768         # lax.scan edge chunking (0 = off)
-    remat: bool = True
+    remat: bool | str = True    # bool or checkpoint-policy name (ops/chunk)
     dtype: str = "float32"
 
     @property
@@ -377,20 +378,9 @@ class ESCNMD:
             chunked(pad_rows(env, pad), K_ch, chunk),
         )
 
-        def wigner_blocks(rhatc):
-            """Per-l lab-from-edge blocks. Built at >= fp32 (never bf16:
-            the trig chains compound) in the geometry precision, downcast
-            per-use in rotate_in/rotate_out."""
-            wdt = jnp.promote_types(rhatc.dtype, jnp.float32)
-            alpha, beta = edge_angles(rhatc.astype(wdt))
-            out = []
-            for l in range(cfg.lmax + 1):
-                J = jnp.asarray(jd_np(l), dtype=wdt)
-                D = jnp.einsum("epq,qr,ers,st->ept",
-                               _z_rot_jnp(l, alpha), J,
-                               _z_rot_jnp(l, beta), J)
-                out.append(D)
-            return out
+        # per-l lab-from-edge blocks; ops/so3_e3nn builds them at >= fp32
+        # with pole-safe angles, downcast per-use in rotate_in/rotate_out
+        wigner_blocks = partial(wigner_blocks_from_edges, cfg.lmax)
 
         def rotate_in(hvecs, D):
             """Lab (E_c, S_full, c) -> edge frame (E_c, S_nar, c): transpose

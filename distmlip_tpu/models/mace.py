@@ -85,7 +85,10 @@ class MACEConfig:
                               # learned potential (ref mace/models.py:121-128)
     atomic_numbers: tuple | None = None  # species index -> Z (for ZBL);
                                          # default: index + 1
-    remat: bool = True   # rematerialize each interaction in the backward pass
+    remat: bool | str = True  # rematerialize in the backward pass: True
+                              # (full), False, or a checkpoint-policy name
+                              # ("dots": keep GEMM outputs, recompute glue
+                              # — ops/chunk.remat_wrap)
     edge_chunk: int = 32768  # process edges in chunks of this size inside a
                              # lax.scan: bounds the per-edge path-tensor and
                              # radial-weight memory regardless of system size
@@ -359,7 +362,10 @@ class MACE:
         for t, inter in enumerate(params["interactions"]):
             body = partial(self._interaction, lg=lg, Y=Y, bessel=bessel,
                            z=z, t=t)
-            if cfg.remat:
+            if cfg.remat is True:
+                # full-remat mode only: with a policy, the inner edge/node
+                # scans carry the policy themselves and double-wrapping
+                # would discard their saved dots
                 body = jax.checkpoint(body)
             h = body(inter, h)
             h = self._unpack(lg.halo_exchange(self._pack(h)), self.h_ls_out[t], C)
@@ -525,10 +531,15 @@ class MACE:
                 outs.append(m)
             return None, jnp.concatenate(outs, axis=1)
 
+        from ..ops.chunk import remat_wrap
+
+        body = remat_wrap(node_body, cfg.remat)
         if Kn == 1:
-            _, out_flat = node_body(None, (A_ch[0], z_ch[0], h_ch[0]))
+            # single-chunk path keeps the remat mode too (same contract as
+            # scan_accumulate: a system just under one node chunk must have
+            # the same backward memory bound as one just over)
+            _, out_flat = body(None, (A_ch[0], z_ch[0], h_ch[0]))
         else:
-            body = jax.checkpoint(node_body) if cfg.remat else node_body
             _, out_flat = jax.lax.scan(body, None, (A_ch, z_ch, h_ch))
             out_flat = out_flat.reshape(Kn * nchunk, -1, C)[:n_nodes]
 

@@ -47,15 +47,43 @@ def chunked(x, K: int, chunk: int):
     return x.reshape((K, chunk) + x.shape[1:])
 
 
-def scan_accumulate(body, acc0, xs, *, remat: bool):
+def remat_wrap(body, remat):
+    """Apply the requested rematerialization mode to a scan body.
+
+    ``remat`` is False (save everything), True (full checkpoint: recompute
+    the whole chunk in the backward — minimal memory, ~2x backward FLOPs),
+    or the name of a jax checkpoint policy — most usefully ``"dots"``
+    (``dots_with_no_batch_dims_saveable``: keep GEMM outputs resident,
+    recompute only the cheap elementwise/gather glue; backward stops
+    re-running the MXU work that dominates the step, for a bounded
+    activation-memory increase). The policy axis is a measurement knob for
+    the round-3 finding that the remat backward is ~3x the forward
+    (ROADMAP.md): tools/tune_mace.py sweeps it on chip.
+    """
+    if remat is False:
+        return body
+    if remat is True:
+        return jax.checkpoint(body)
+    policies = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+    }
+    if remat not in policies:
+        raise ValueError(f"remat={remat!r}: expected bool or one of "
+                         f"{sorted(policies)}")
+    return jax.checkpoint(body, policy=policies[remat])
+
+
+def scan_accumulate(body, acc0, xs, *, remat):
     """Sum ``body`` over chunks: ``body(acc, xs_i) -> (acc', None)``.
 
-    The body is checkpointed whenever ``remat`` — including for K == 1, so
-    a system just under one chunk keeps the same bounded backward memory
-    as one just over (the single chunk's per-edge intermediates are the
-    largest residuals there).
+    The body is checkpointed whenever ``remat`` (bool or policy name, see
+    ``remat_wrap``) — including for K == 1, so a system just under one
+    chunk keeps the same bounded backward memory as one just over (the
+    single chunk's per-edge intermediates are the largest residuals there).
     """
-    b = jax.checkpoint(body) if remat else body
+    b = remat_wrap(body, remat)
     K = jax.tree.leaves(xs)[0].shape[0]
     if K == 1:
         acc, _ = b(acc0, jax.tree.map(lambda x: x[0], xs))

@@ -97,11 +97,28 @@ def _z_rot_jnp(l: int, angles):
     return M
 
 
-def edge_angles(rhat):
-    """e3nn (alpha, beta) of unit vectors; beta clipped away from the poles
-    only through the acos argument (the Jd pipeline itself is smooth)."""
-    alpha = jnp.arctan2(rhat[..., 0], rhat[..., 2])
-    beta = jnp.arccos(jnp.clip(rhat[..., 1], -1.0, 1.0))
+def edge_angles(rhat, eps: float = 1e-4):
+    """e3nn (alpha, beta) of unit vectors, gradient-safe at the poles.
+
+    At u = +-y-hat the azimuth is a pure gauge freedom, but atan2's gradient
+    at (0, 0) is NaN and arccos's at +-1 is infinite — one pole-aligned edge
+    (any ideal cubic crystal has them) would NaN the whole force array.
+    Within ~eps of the pole the angle arguments are replaced by constants
+    (alpha := 0, |cos beta| clipped to sqrt(1 - eps^2)): values are off by
+    O(eps) only there, gradients flow zero through the substituted branch
+    (a valid gauge choice), and everywhere else the computation is exact.
+    """
+    x, y, z = rhat[..., 0], rhat[..., 1], rhat[..., 2]
+    rho2 = x * x + z * z
+    safe = rho2 > (eps * eps)
+    alpha = jnp.arctan2(jnp.where(safe, x, 0.0), jnp.where(safe, z, 1.0))
+    # the clip limit must be STRICTLY below 1 in the working dtype — in
+    # float32, 1 - eps^2/2 rounds to exactly 1.0 and arccos'(1) = -inf
+    # would still NaN pole-aligned edges; nextafter guarantees >= 1 ulp
+    npdt = np.dtype(rhat.dtype.name if hasattr(rhat, "dtype") else "float64")
+    y_lim = float(np.nextafter(npdt.type(1.0 - eps * eps / 2),
+                               npdt.type(0.0)))
+    beta = jnp.arccos(jnp.clip(y, -y_lim, y_lim))
     return alpha, beta
 
 
@@ -116,10 +133,11 @@ def wigner_blocks_from_edges(l_max: int, rhat):
     identical model output; fairchem instead carries the gamma of its
     edge_rot_mat construction, reference escn_md.py:99-109).
     """
-    alpha, beta = edge_angles(rhat)
+    wdt = jnp.promote_types(rhat.dtype, jnp.float32)  # never bf16: the trig
+    alpha, beta = edge_angles(rhat.astype(wdt))       # chains compound
     out = []
     for l in range(l_max + 1):
-        J = jnp.asarray(jd_np(l), dtype=rhat.dtype)
+        J = jnp.asarray(jd_np(l), dtype=wdt)
         Xa = _z_rot_jnp(l, alpha)
         Xb = _z_rot_jnp(l, beta)
         out.append(jnp.einsum("epq,qr,ers,st->ept", Xa, J, Xb, J))

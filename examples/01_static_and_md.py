@@ -6,6 +6,9 @@ static calc, then a short NVT trajectory.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
@@ -32,7 +35,8 @@ atoms = Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
 model = MACE(MACEConfig(cutoff=5.0))
 params = model.init(jax.random.PRNGKey(0))  # or utils.load_params("mace.npz")
 
-pot = DistPotential(model, params, skin=0.5)  # all visible devices
+# default AUTO partitioning: all devices, clamped by the slab rule
+pot = DistPotential(model, params, skin=0.5)
 res = pot.calculate(atoms)
 print(f"E = {res['energy']:.4f} eV   |F|max = {np.abs(res['forces']).max():.4f} eV/A")
 print(pot.partition_report(atoms))

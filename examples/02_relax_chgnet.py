@@ -1,6 +1,9 @@
 """Structure relaxation (positions + cell) with distributed CHGNet."""
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
@@ -24,6 +27,7 @@ atoms = Atoms(numbers=np.full(len(cart), 3), positions=cart, cell=lattice * 1.02
 
 model = CHGNet(CHGNetConfig(cutoff=5.0, bond_cutoff=3.0))
 params = model.init(jax.random.PRNGKey(0))
+# default AUTO partitioning: all devices, clamped by the slab rule
 pot = DistPotential(model, params, skin=0.4)
 
 out = Relaxer(pot, optimizer="fire", relax_cell=True).relax(atoms, steps=300)

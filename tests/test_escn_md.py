@@ -100,6 +100,24 @@ def test_mmax_narrowing_runs_and_differs(rng, model, params):
     assert np.isfinite(e1)
 
 
+def test_ideal_crystal_forces_finite(model, params):
+    """An UNPERTURBED cubic crystal has bonds exactly along +-y (the e3nn
+    polar axis): forces must be finite (pole-safe Wigner gradients), and
+    near-zero by symmetry on interior atoms."""
+    from distmlip_tpu import geometry
+
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 4.4, (2, 2, 2))
+    cart = geometry.frac_to_cart(frac, lattice)  # NO noise: exact alignment
+    species = np.zeros(len(cart), np.int32)
+    e, f, _ = run_potential(model.energy_fn, params, cart, lattice, species,
+                            CUT, nparts=1)
+    assert np.isfinite(e)
+    assert np.all(np.isfinite(f)), f
+    # perfect-lattice symmetry: net force per atom ~0
+    assert np.abs(f).max() < 1e-2, np.abs(f).max()
+
+
 def test_csd_conditioning_changes_energy(rng, model, params):
     """Charge/spin/dataset must modulate the energy (UMA conditioning) and
     stay consistent across partitionings."""

@@ -68,6 +68,8 @@ def main():
     configs = [
         # (remat, edge_chunk, node_chunk, stress, dtype)
         (True, 32768, 4096, True, "bfloat16"),    # bench default (baseline)
+        ("dots", 32768, 4096, True, "bfloat16"),  # keep GEMMs, redo glue
+        ("dots", 65536, 8192, True, "bfloat16"),
         (False, 32768, 4096, True, "bfloat16"),   # no remat
         (False, 65536, 4096, True, "bfloat16"),
         (False, 131072, 4096, True, "bfloat16"),

@@ -44,3 +44,8 @@ DISTMLIP_REAL_DEVICES=1 python examples/05_scale_ladder.py --config 4 \
 rc=$?
 echo "$(date +%H:%M:%S) ladder config 4 done rc=$rc" >> /tmp/window/log
 echo "$(date +%H:%M:%S) battery complete" >> /tmp/window/log
+# persist artifacts into the repo: if the window opens with no builder
+# turns left, the round-end snapshot commit still carries the numbers
+mkdir -p window_r04
+cp /tmp/window/* window_r04/ 2>/dev/null
+echo "$(date +%H:%M:%S) artifacts copied to window_r04/" >> /tmp/window/log

@@ -26,7 +26,7 @@ import time
 import numpy as np
 
 from ..neighbors import neighbor_list
-from ..parallel import graph_mesh, make_potential_fn
+from ..parallel import graph_mesh, make_potential_fn, make_site_fn
 from ..partition import CapacityPolicy, build_partitioned_graph, build_plan
 from .atoms import EV_A3_TO_GPA, Atoms
 
@@ -57,6 +57,7 @@ class DistPotential:
         skin: float = 0.0,
         compute_dtype: str | None = None,
         partition_grid: tuple | None = None,
+        compute_magmom: bool = False,
     ):
         import jax
 
@@ -106,6 +107,11 @@ class DistPotential:
         self.bond_cutoff = float(getattr(model.cfg, "bond_cutoff", 0.0))
         self.use_bond_graph = bool(getattr(model.cfg, "use_bond_graph", False))
         self.compute_stress = bool(compute_stress)
+        if compute_magmom and not hasattr(model, "magmom_fn"):
+            raise ValueError(
+                f"{type(model).__name__} has no magmom_fn (sitewise "
+                f"readout); compute_magmom is a CHGNet-family capability")
+        self.compute_magmom = bool(compute_magmom)
         self.skin = float(skin)
         # default num_partitions is AUTO: all devices, clamped by the slab
         # rule (box extent / partition > 2 * build cutoff) for the first
@@ -129,6 +135,10 @@ class DistPotential:
         )
         self._potential = make_potential_fn(
             self.model.energy_fn, self.mesh, compute_stress=self.compute_stress
+        )
+        self._site_fn = (
+            make_site_fn(self.model.magmom_fn, self.mesh)
+            if self.compute_magmom else None
         )
 
     def _auto_partition_count(self, atoms: Atoms) -> int:
@@ -287,14 +297,21 @@ class DistPotential:
         energy = float(out["energy"])
         forces = host.gather_owned(np.asarray(out["forces"]), len(atoms))
         stress = np.asarray(out["stress"])
-        self.last_timings["device_s"] = time.perf_counter() - t2
-        return {
+        result = {
             "energy": energy,
             "free_energy": energy,
             "forces": forces,
             "stress": stress,
             "stress_GPa": stress * EV_A3_TO_GPA,
         }
+        if self._site_fn is not None:
+            # sitewise readout (CHGNet magmoms; reference ase.py magmoms
+            # surface) over the SAME cached graph/positions
+            m = np.asarray(self._site_fn(self.params, graph, positions))
+            result["magmoms"] = host.gather_owned(m[..., None],
+                                                  len(atoms))[:, 0]
+        self.last_timings["device_s"] = time.perf_counter() - t2
+        return result
 
     def partition_report(self, atoms: Atoms) -> str:
         """Partition-balance diagnostics (reference dist.py:704-721)."""
@@ -312,7 +329,8 @@ def make_ase_calculator(potential: DistPotential):
     from ase.calculators.calculator import Calculator, all_changes
 
     class DistMLIPCalculator(Calculator):
-        implemented_properties = ["energy", "free_energy", "forces", "stress"]
+        implemented_properties = ["energy", "free_energy", "forces", "stress",
+                                  "magmoms"]
 
         def __init__(self, pot, **kw):
             super().__init__(**kw)
@@ -331,6 +349,8 @@ def make_ase_calculator(potential: DistPotential):
                     [s[0, 0], s[1, 1], s[2, 2], s[1, 2], s[0, 2], s[0, 1]]
                 ),
             }
+            if "magmoms" in res:
+                self.results["magmoms"] = res["magmoms"]
 
     return DistMLIPCalculator(potential)
 
@@ -400,7 +420,7 @@ class EnsemblePotential:
                 lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *params_list
             )
             self._vpot = None  # built lazily: AUTO partitioning defers
-            #                    base._potential until the first cell is seen
+            self._vsite = None  # base._potential until the first cell is seen
         else:
             self.members = [base] + [
                 DistPotential(model, p, **kwargs) for p in params_list[1:]
@@ -414,6 +434,9 @@ class EnsemblePotential:
                 import jax
 
                 self._vpot = jax.vmap(base._potential, in_axes=(0, None, None))
+                if base._site_fn is not None:
+                    self._vsite = jax.vmap(base._site_fn,
+                                           in_axes=(0, None, None))
             t2 = time.perf_counter()
             out = self._vpot(self.stacked_params, graph, positions)
             energies = np.asarray(out["energy"], dtype=np.float64)
@@ -423,13 +446,23 @@ class EnsemblePotential:
                 for k in range(forces_all.shape[0])
             ])
             stresses = np.asarray(out["stress"])
+            magmoms = None
+            if self._vsite is not None:
+                m_all = np.asarray(self._vsite(self.stacked_params, graph,
+                                               positions))
+                magmoms = np.stack([
+                    host.gather_owned(m_all[k][..., None], len(atoms))[:, 0]
+                    for k in range(m_all.shape[0])
+                ])
             base.last_timings["device_s"] = time.perf_counter() - t2
         else:
             results = [m.calculate(atoms) for m in self.members]
             energies = np.array([r["energy"] for r in results])
             forces = np.stack([r["forces"] for r in results])
             stresses = np.stack([r["stress"] for r in results])
-        return {
+            magmoms = (np.stack([r["magmoms"] for r in results])
+                       if "magmoms" in results[0] else None)
+        result = {
             "energy": float(energies.mean()),
             "free_energy": float(energies.mean()),
             "forces": forces.mean(axis=0),
@@ -439,3 +472,7 @@ class EnsemblePotential:
             "energies": energies,
             "forces_all": forces,
         }
+        if magmoms is not None:
+            result["magmoms"] = magmoms.mean(axis=0)
+            result["magmoms_all"] = magmoms
+        return result

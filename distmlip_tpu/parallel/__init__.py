@@ -1,6 +1,7 @@
 from .mesh import GRAPH_AXIS, graph_mesh
 from .halo import LocalGraph, local_graph_from_stacked
-from .runtime import make_total_energy, make_potential_fn, graph_in_specs
+from .runtime import (make_total_energy, make_potential_fn,
+                      make_site_fn, graph_in_specs)
 
 __all__ = [
     "GRAPH_AXIS",
@@ -9,5 +10,6 @@ __all__ = [
     "local_graph_from_stacked",
     "make_total_energy",
     "make_potential_fn",
+    "make_site_fn",
     "graph_in_specs",
 ]

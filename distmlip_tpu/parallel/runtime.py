@@ -97,6 +97,48 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None):
     return total_energy
 
 
+def make_site_fn(model_site_fn, mesh: Mesh | None):
+    """Jitted sharded per-atom readout: (params, graph, positions) ->
+    (P, N_cap) site values (e.g. CHGNet magmoms — reference
+    PESCalculator_Dist's compute_magmom surface, implementations/matgl/
+    ase.py:53-127). Halo rows are refreshed in-jit like the energy path;
+    reassemble owned rows with HostGraphData.gather_owned.
+
+    Runs a SEPARATE forward pass from the energy program (magmom_fn is its
+    own readout path); fusing it as an aux output of the energy forward
+    would need model energy_fns to return aux — a known follow-up if
+    magmom-every-step MD becomes a hot path."""
+
+    def local_site(params, graph_local, positions):
+        axis = GRAPH_AXIS if mesh is not None else None
+        lg, _ = local_graph_from_stacked(graph_local, axis)
+        pos = lg.halo_exchange(positions[0])
+        return model_site_fn(params, lg, pos)[None]
+
+    if mesh is None:
+        @jax.jit
+        def site_fn(params, graph, positions):
+            if graph.num_partitions != 1:
+                raise ValueError(
+                    f"mesh=None requires a single-partition graph, got "
+                    f"P={graph.num_partitions}; pass mesh=graph_mesh(P).")
+            return local_site(params, graph, positions)
+        return site_fn
+
+    @jax.jit
+    def site_fn(params, graph, positions):
+        sharded = shard_map(
+            local_site,
+            mesh=mesh,
+            in_specs=(P(), graph_in_specs(graph), P(GRAPH_AXIS)),
+            out_specs=P(GRAPH_AXIS),
+            check_vma=False,
+        )
+        return sharded(params, graph, positions)
+
+    return site_fn
+
+
 def make_potential_fn(model_energy_fn, mesh: Mesh | None, compute_stress: bool = True):
     """Jitted (params, graph, positions) -> dict(energy, forces, stress).
 

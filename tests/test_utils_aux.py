@@ -101,3 +101,46 @@ def test_chunk_spec_edge_cases():
     assert chunk_spec(100, 30) == (4, 30, 20)      # remainder padded
     assert chunk_spec(10, 1000) == (1, 10, 0)      # chunk > e_cap clamps
     assert chunk_spec(0, 32768) == (1, 0, 0)       # edgeless graph
+
+
+def test_checkpoint_layout_version_gate(tmp_path):
+    """A checkpoint without the layout-version sentinel (pre-channels-last
+    era) must be refused by default — shapes match across the flip, so a
+    silent load would compute wrong energies (ADVICE r3)."""
+    import numpy as np
+    import pytest
+
+    from distmlip_tpu.utils import checkpoint as ckpt
+
+    params = {"a": {"w": np.arange(6.0).reshape(2, 3)}}
+    legacy = tmp_path / "legacy.npz"
+    np.savez_compressed(legacy, **ckpt._flatten_with_paths(params))
+    with pytest.raises(ValueError, match="layout version"):
+        ckpt.load_params(str(legacy), like=params)
+    back = ckpt.load_params(str(legacy), like=params, allow_legacy_layout=True)
+    np.testing.assert_array_equal(back["a"]["w"], params["a"]["w"])
+    # current-era saves round-trip and the sentinel never leaks into trees
+    cur = tmp_path / "cur.npz"
+    ckpt.save_params(str(cur), params)
+    assert ckpt._LAYOUT_KEY not in ckpt.load_params(str(cur))
+
+
+def test_checkpoint_namedtuple_roundtrip(tmp_path):
+    """Optax optimizer states are NamedTuples: save/load must reconstruct
+    them positionally (train.save/load_train_state relies on this)."""
+    import numpy as np
+    import optax
+
+    from distmlip_tpu.utils.checkpoint import load_params, save_params
+
+    params = {"w": np.ones((3, 2), np.float32)}
+    opt = optax.adam(1e-3)
+    state = opt.init(params)
+    path = tmp_path / "state.npz"
+    save_params(str(path), {"opt": state})
+    back = load_params(str(path), like={"opt": state})
+    assert type(back["opt"]) is type(state)
+    import jax
+
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(back["opt"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -9,6 +9,14 @@
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/window
 rm -f /tmp/tpu_up
+# persist artifacts into the repo on EVERY exit path (the failure cases are
+# exactly the logs the round-end snapshot commit most needs)
+persist() {
+  mkdir -p window_r04
+  cp /tmp/window/* window_r04/ 2>/dev/null
+  echo "$(date +%H:%M:%S) artifacts copied to window_r04/" >> window_r04/log
+}
+trap persist EXIT
 while [ ! -f /tmp/tpu_up ]; do sleep 60; done
 echo "$(date +%H:%M:%S) chip is up — starting battery" >> /tmp/window/log
 python bench.py > /tmp/window/bench.json 2> /tmp/window/bench.err
@@ -44,8 +52,3 @@ DISTMLIP_REAL_DEVICES=1 python examples/05_scale_ladder.py --config 4 \
 rc=$?
 echo "$(date +%H:%M:%S) ladder config 4 done rc=$rc" >> /tmp/window/log
 echo "$(date +%H:%M:%S) battery complete" >> /tmp/window/log
-# persist artifacts into the repo: if the window opens with no builder
-# turns left, the round-end snapshot commit still carries the numbers
-mkdir -p window_r04
-cp /tmp/window/* window_r04/ 2>/dev/null
-echo "$(date +%H:%M:%S) artifacts copied to window_r04/" >> /tmp/window/log

@@ -308,8 +308,7 @@ class DistPotential:
             # sitewise readout (CHGNet magmoms; reference ase.py magmoms
             # surface) over the SAME cached graph/positions
             m = np.asarray(self._site_fn(self.params, graph, positions))
-            result["magmoms"] = host.gather_owned(m[..., None],
-                                                  len(atoms))[:, 0]
+            result["magmoms"] = host.gather_owned(m, len(atoms))
         self.last_timings["device_s"] = time.perf_counter() - t2
         return result
 
@@ -329,12 +328,15 @@ def make_ase_calculator(potential: DistPotential):
     from ase.calculators.calculator import Calculator, all_changes
 
     class DistMLIPCalculator(Calculator):
-        implemented_properties = ["energy", "free_energy", "forces", "stress",
-                                  "magmoms"]
+        implemented_properties = ["energy", "free_energy", "forces", "stress"]
 
         def __init__(self, pot, **kw):
             super().__init__(**kw)
             self.pot = pot
+            if pot.compute_magmom:
+                # advertise per instance: ASE branches on this list
+                self.implemented_properties = (
+                    self.implemented_properties + ["magmoms"])
 
         def calculate(self, atoms=None, properties=None, system_changes=all_changes):
             super().calculate(atoms, properties, system_changes)
@@ -419,8 +421,10 @@ class EnsemblePotential:
             self.stacked_params = jax.tree.map(
                 lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *params_list
             )
-            self._vpot = None  # built lazily: AUTO partitioning defers
-            self._vsite = None  # base._potential until the first cell is seen
+            # built lazily: AUTO partitioning defers base._potential until
+            # the first cell is seen
+            self._vpot = None
+            self._vsite = None
         else:
             self.members = [base] + [
                 DistPotential(model, p, **kwargs) for p in params_list[1:]
@@ -451,7 +455,7 @@ class EnsemblePotential:
                 m_all = np.asarray(self._vsite(self.stacked_params, graph,
                                                positions))
                 magmoms = np.stack([
-                    host.gather_owned(m_all[k][..., None], len(atoms))[:, 0]
+                    host.gather_owned(m_all[k], len(atoms))
                     for k in range(m_all.shape[0])
                 ])
             base.last_timings["device_s"] = time.perf_counter() - t2

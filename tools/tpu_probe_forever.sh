@@ -1,0 +1,24 @@
+#!/bin/bash
+# Single NO-TIMEOUT probe for a wedged axon chip grant.
+#
+# Rationale (round-4 lesson): every timeout-KILLED probe is itself a
+# mid-claim client death, which renews the server-side lease wedge — the
+# 20-min-probe/40-min-backoff watcher never let the lease expire in >6 h.
+# A claim that simply WAITS holds no lease and kills nothing: when the
+# stale lease finally expires (or an operator resets the relay), the
+# pending claim is granted, the matmul runs, the marker is written, and
+# the process exits cleanly. Pair with tools/when_up.sh.
+rm -f /tmp/tpu_up
+python - <<'EOF' >> /tmp/tpu_watch.log 2>&1
+import time
+t0 = time.time()
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256, 256), jnp.bfloat16)
+s = float((x @ x).sum())
+line = (f"{time.strftime('%H:%M:%S')} FOREVER-PROBE OK after "
+        f"{time.time() - t0:.0f}s: {d[0].platform} {d[0].device_kind} {s}")
+print(line)
+with open("/tmp/tpu_up", "w") as f:
+    f.write(line + "\n")
+EOF

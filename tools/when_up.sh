@@ -7,13 +7,16 @@
 # from a previous window is removed here so an old file cannot fire the
 # battery against a down backend.
 cd "$(dirname "$0")/.."
+# clear stale artifacts from any prior window so the EXIT-trap persist can
+# never commit old numbers as this round's results
+rm -rf /tmp/window
 mkdir -p /tmp/window
 rm -f /tmp/tpu_up
 # persist artifacts into the repo on EVERY exit path (the failure cases are
 # exactly the logs the round-end snapshot commit most needs)
 persist() {
   mkdir -p window_r04
-  cp /tmp/window/* window_r04/ 2>/dev/null
+  cp -r /tmp/window/* window_r04/ 2>/dev/null
   echo "$(date +%H:%M:%S) artifacts copied to window_r04/" >> window_r04/log
 }
 trap persist EXIT

@@ -78,14 +78,55 @@ def export_mace(model_path: str, out_path: str) -> None:
     print(f"exported {len(sd)} tensors -> {out_path}")
 
 
+def export_state_dict(model_path: str, out_path: str) -> None:
+    """matgl (chgnet/tensornet) and fairchem (escn/UMA) exporter.
+
+    Loads the checkpoint and dumps every state-dict tensor; the per-arch
+    MAPPINGS handle the prefixes as-is ("model." for matgl Potential dumps,
+    "backbone." for whole-model UMA dumps). Plain state-dict checkpoints
+    (fairchem's format) load without the upstream package; pickled Module
+    checkpoints need it importable for unpickling.
+    """
+    import torch
+
+    obj = torch.load(model_path, map_location="cpu", weights_only=False)
+    if isinstance(obj, dict):
+        # fairchem-style: {"state_dict": ...} or a raw state dict
+        sd = obj.get("state_dict", obj)
+        sd = {k: v for k, v in sd.items() if hasattr(v, "detach")}
+        # fairchem wraps in DDP-ish prefixes: strip a leading "module."
+        sd = {(k[len("module."):] if k.startswith("module.") else k): v
+              for k, v in sd.items()}
+    else:
+        # matgl Potential wrappers export whole: the mappings accept the
+        # "model." prefix, and data_mean/std/element_refs ride along
+        sd = obj.state_dict()
+    # bf16 (and other non-numpy) dtypes upcast to fp32 for the npz
+    numpy_ok = (torch.float32, torch.float64, torch.int32, torch.int64,
+                torch.bool, torch.int8, torch.uint8, torch.int16)
+    out = {k: (v.detach().cpu().numpy() if v.dtype in numpy_ok
+               else v.detach().cpu().float().numpy())
+           for k, v in sd.items()}
+    np.savez_compressed(out_path, **out)
+    print(f"exported {len(out)} tensors -> {out_path}")
+
+
+_EXPORTERS = {
+    "mace": export_mace,
+    "chgnet": export_state_dict,
+    "tensornet": export_state_dict,
+    "escn": export_state_dict,
+}
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
-    if len(argv) != 3 or argv[0] not in ("mace",):
+    if len(argv) != 3 or argv[0] not in _EXPORTERS:
         print(__doc__)
         print("usage: python -m distmlip_tpu.tools.export_upstream "
-              "mace <model.pt> <out.npz>")
+              f"{{{'|'.join(sorted(_EXPORTERS))}}} <model.pt> <out.npz>")
         return 2
-    export_mace(argv[1], argv[2])
+    _EXPORTERS[argv[0]](argv[1], argv[2])
     return 0
 
 

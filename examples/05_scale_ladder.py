@@ -5,9 +5,11 @@ partition. On a machine without 4 real chips this runs on a virtual
 8-device CPU mesh (slow but exact). Round-2 result (2026-07-29, CPU mesh):
 48,668 atoms — 4-way == 1-way to 2.5e-9 eV/atom, dF_max 9.9e-8 eV/Å.
 
-Run: python examples/05_scale_ladder.py [--config 2|3|4]
+Run: python examples/05_scale_ladder.py [--config 2|3|4|5]
   2: TensorNet ~49k atoms, 4-way    3: MACE ~192k atoms, 8-way
   4: eSCN/UMA ~101k atoms, 8-way (csd + MOLE + chunked Wigner/SO(2))
+  5: MACE ~1M atoms, 16-way over a virtual 2-host x 8-chip topology
+     (BASELINE config 5 proxy; DISTMLIP_C5_REPS shrinks the box)
 Set DISTMLIP_REAL_DEVICES=1 to run configs 3/4 single-chip on real
 hardware (bf16, production model shapes) instead of the CPU-mesh
 correctness compare.
@@ -21,10 +23,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 # default: virtual CPU mesh (set DISTMLIP_REAL_DEVICES=1 to use real chips;
-# probing jax.devices() first would initialize the backend and pin us to it)
+# probing jax.devices() first would initialize the backend and pin us to it).
+# config 5 (the multi-host proxy) needs 16 virtual devices — decided BEFORE
+# the backend initializes.
+_N_VIRT = 16 if ("--config" in sys.argv
+                 and sys.argv[sys.argv.index("--config") + 1] == "5") else 8
 if not os.environ.get("DISTMLIP_REAL_DEVICES"):
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    jax.config.update("jax_num_cpu_devices", _N_VIRT)
 
 import time
 
@@ -44,18 +50,20 @@ def _print_hbm():
               f"(in use {stats.get('bytes_in_use', 0) / 2**30:.2f} GiB)")
 
 
-def compare_partitions(tag, model, params, atoms, smap, P, tol_de, tol_df):
-    """P-way vs 1-way energy/forces compare — the ladder's shared check."""
+def compare_partitions(tag, model, params, atoms, smap, P, tol_de, tol_df,
+                       baseline=1):
+    """P-way vs baseline-way energy/forces compare — the ladder's shared
+    check."""
     results = {}
-    for n in (P, 1):
+    for n in (P, baseline):
         t0 = time.time()
         pot = DistPotential(model, params, num_partitions=n, species_map=smap)
         results[n] = pot.calculate(atoms)
         print(f"{n}-way: E={results[n]['energy']:.4f} "
               f"({time.time() - t0:.0f}s incl compile)")
-    de = abs(results[P]["energy"] - results[1]["energy"]) / len(atoms)
-    df = np.abs(results[P]["forces"] - results[1]["forces"]).max()
-    print(f"{P}-way vs 1-way: dE/atom={de:.2e} eV  dF_max={df:.2e} eV/Å")
+    de = abs(results[P]["energy"] - results[baseline]["energy"]) / len(atoms)
+    df = np.abs(results[P]["forces"] - results[baseline]["forces"]).max()
+    print(f"{P}-way vs {baseline}-way: dE/atom={de:.2e} eV  dF_max={df:.2e} eV/Å")
     assert de < tol_de and df < tol_df
     print(f"CONFIG {tag} PASSED")
 
@@ -188,10 +196,43 @@ def config4():
     compare_partitions(4, model, params, atoms, smap, 8, 1e-5, 1e-3)
 
 
-if __name__ == "__main__":
-    import sys
+def config5():
+    """MACE, ~1M-atom H/C/N/O box, 16-way — BASELINE config 5's
+    multi-host stretch as a virtual-topology proxy: 16 shards stand in for
+    a 2-host x 8-chip slice (the ring ppermute crosses the proxy host
+    boundary exactly where DCN would sit; jax.devices() spans hosts by
+    construction, so the same program runs unchanged on a real pod
+    slice). Validates 16-way == 4-way at the north-star atom count; model
+    is CPU-mesh-sized (the real-chip shape is bench.py's)."""
+    from distmlip_tpu.models import MACE, MACEConfig
 
+    rng = np.random.default_rng(0)
+    reps = int(os.environ.get("DISTMLIP_C5_REPS", "63"))
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 4.0,
+                                            (reps, reps, reps))
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, 0.05, (len(frac), 3))
+    # solvated-protein-ish composition: H-heavy with C/N/O
+    numbers = rng.choice([1, 1, 1, 6, 6, 7, 8], size=len(cart))
+    atoms = Atoms(numbers=numbers, positions=cart, cell=lattice)
+    smap = np.full(9, -1, np.int32)
+    smap[1], smap[6], smap[7], smap[8] = 0, 1, 2, 3
+    print(f"config 5: MACE, n_atoms = {len(atoms)}, 16-way "
+          f"(2-host x 8-chip proxy topology)")
+
+    cfg = MACEConfig(num_species=4, channels=32, l_max=2, a_lmax=2,
+                     hidden_lmax=1, correlation=2, num_interactions=2,
+                     num_bessel=6, radial_mlp=32, cutoff=5.0,
+                     avg_num_neighbors=40.0)
+    model = MACE(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    compare_partitions(5, model, params, atoms, smap, 16, 1e-5, 1e-3,
+                       baseline=4)
+
+
+if __name__ == "__main__":
     which = "2"
     if "--config" in sys.argv:
         which = sys.argv[sys.argv.index("--config") + 1]
-    {"2": config2, "3": config3, "4": config4}[which]()
+    {"2": config2, "3": config3, "4": config4, "5": config5}[which]()

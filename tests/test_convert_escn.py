@@ -406,3 +406,24 @@ def test_mole_shaped_dict_converts():
     # every backbone tensor maps; only the (framework-side) MOLE gate has
     # no fairchem analogue in the synthetic dict
     assert report["unused_torch"] == []
+
+
+def test_export_roundtrip_converts(tmp_path):
+    """tools/export_upstream escn: a fairchem-style checkpoint file
+    ({"state_dict": {"module....": tensors}}) exports to npz and converts
+    with zero unmapped tensors — the full offline-ingestion pipeline."""
+    from distmlip_tpu.tools.export_upstream import main as export_main
+
+    sd = synthetic_escn_state_dict()
+    ckpt = str(tmp_path / "uma.pt")
+    torch.save({"state_dict": {("module." + k): v for k, v in sd.items()}},
+               ckpt)
+    out = str(tmp_path / "uma.npz")
+    assert export_main(["escn", ckpt, out]) == 0
+    back = dict(np.load(out))
+    assert set(back) == set(sd)
+    model = ESCNMD(CFG)
+    params, report = from_torch("escn", back,
+                                model.init(jax.random.PRNGKey(3)),
+                                model=model)
+    assert report["unused_torch"] == []

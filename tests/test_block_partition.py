@@ -123,6 +123,7 @@ def test_block_matches_single_device_mace(rng):
     np.testing.assert_allclose(s1, s8, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_block_grid_via_calculator(rng):
     """DistPotential(partition_grid=...) end to end, including skin reuse."""
     from distmlip_tpu.calculators import Atoms, DistPotential

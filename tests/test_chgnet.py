@@ -162,6 +162,7 @@ def test_magmoms_through_calculator(rng, params):
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ensemble_magmoms(rng, params):
     """compute_magmom through EnsemblePotential: both the stacked (vmapped
     site fn) and sequential paths surface per-member + mean magmoms."""

@@ -1,24 +1,36 @@
 #!/bin/bash
-# Single NO-TIMEOUT probe for a wedged axon chip grant.
+# NO-TIMEOUT probe loop for a wedged axon chip grant.
 #
 # Rationale (round-4 lesson): every timeout-KILLED probe is itself a
 # mid-claim client death, which renews the server-side lease wedge — the
 # 20-min-probe/40-min-backoff watcher never let the lease expire in >6 h.
-# A claim that simply WAITS holds no lease and kills nothing: when the
-# stale lease finally expires (or an operator resets the relay), the
-# pending claim is granted, the matmul runs, the marker is written, and
-# the process exits cleanly. Pair with tools/when_up.sh.
+# The backend alternates two failure modes: fast-fail (claim RAISES
+# "UNAVAILABLE" — harmless, the attempt completes) and hang (claim never
+# returns). So: probe with NO timeout. A fast-fail retries on a 3-min
+# cadence; a hang simply WAITS (kills nothing, holds no lease) until the
+# stale lease expires and the pending claim is granted. On success the
+# matmul runs, the marker is written, and the loop exits cleanly. Pair
+# with tools/when_up.sh.
 rm -f /tmp/tpu_up
-python - <<'EOF' >> /tmp/tpu_watch.log 2>&1
+while [ ! -f /tmp/tpu_up ]; do
+  python - <<'EOF' >> /tmp/tpu_watch.log 2>&1
 import time
 t0 = time.time()
-import jax, jax.numpy as jnp
-d = jax.devices()
-x = jnp.ones((256, 256), jnp.bfloat16)
-s = float((x @ x).sum())
-line = (f"{time.strftime('%H:%M:%S')} FOREVER-PROBE OK after "
+try:
+    import jax, jax.numpy as jnp
+    d = jax.devices()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    s = float((x @ x).sum())
+except Exception as e:
+    print(f"{time.strftime('%H:%M:%S')} probe fast-failed after "
+          f"{time.time() - t0:.0f}s: {type(e).__name__}: {str(e)[:120]}")
+    raise SystemExit(1)
+line = (f"{time.strftime('%H:%M:%S')} PROBE OK after "
         f"{time.time() - t0:.0f}s: {d[0].platform} {d[0].device_kind} {s}")
 print(line)
 with open("/tmp/tpu_up", "w") as f:
     f.write(line + "\n")
 EOF
+  [ -f /tmp/tpu_up ] && break
+  sleep 180
+done

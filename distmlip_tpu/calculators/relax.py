@@ -76,8 +76,19 @@ class Relaxer:
         self.maxstep = maxstep
         self.cell_factor = cell_factor
 
-    def relax(self, atoms: Atoms, steps: int = 500, record: bool = False) -> RelaxResult:
+    def relax(self, atoms: Atoms, steps: int = 500, record: bool = False,
+              traj_file: str | None = None, interval: int = 1) -> RelaxResult:
+        """Relax ``atoms``. ``traj_file`` saves a TrajectoryObserver npz
+        every ``interval`` accepted steps (the reference Relaxer's
+        traj_file/interval surface, implementations/matgl/ase.py:171-223);
+        ``record`` additionally keeps a per-step summary in the result."""
+        from .md import TrajectoryObserver
+
+        if interval < 1:
+            raise ValueError(f"interval must be >= 1, got {interval}")
         atoms = atoms.copy()
+        obs = TrajectoryObserver(atoms) if traj_file else None
+        last_recorded = -1
         n = len(atoms)
         cell_factor = self.cell_factor if self.cell_factor is not None else max(n, 1)
         state = {
@@ -116,6 +127,9 @@ class Relaxer:
                 traj.append(
                     {"energy": res["energy"], "fmax": f_norm, "cell": atoms.cell.copy()}
                 )
+            if obs is not None and (it - 1) % interval == 0:
+                obs.record(res)
+                last_recorded = it
             if f_norm < self.fmax and (not self.relax_cell or s_norm < self.smax):
                 converged = True
                 break
@@ -123,6 +137,10 @@ class Relaxer:
             self._apply_step(atoms, step_vec, n, cell_factor, state)
             res = self.potential.calculate(atoms)
 
+        if obs is not None:
+            if last_recorded != it:  # final state, unless the loop-top
+                obs.record(res)      # record already captured it
+            obs.save(traj_file)
         return RelaxResult(
             atoms=atoms, converged=converged, nsteps=it, energy=res["energy"],
             forces=res["forces"], stress=res["stress"], trajectory=traj,

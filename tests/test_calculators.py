@@ -545,3 +545,22 @@ def test_bfloat16_switch_tensornet_chgnet(rng, family):
     df = np.abs(r16["forces"] - r32["forces"]).max() / f_scale
     assert de < 1e-2, de
     assert df < 0.15, df
+
+
+def test_relaxer_traj_file(rng, potential, tmp_path):
+    """traj_file saves a TrajectoryObserver npz during relaxation (the
+    reference Relaxer's traj_file/interval surface)."""
+    atoms = make_atoms(rng, noise=0.1)
+    path = str(tmp_path / "relax.npz")
+    out = Relaxer(potential, fmax=0.05).relax(atoms, steps=100,
+                                              traj_file=path, interval=2)
+    data = np.load(path)
+    assert data["energies"].shape[0] >= 2
+    assert data["positions"].shape[1:] == (len(atoms), 3)
+    # last recorded energy is the final state's, recorded exactly once
+    assert abs(float(data["energies"][-1]) - out.energy) < 1e-8
+    if data["energies"].shape[0] >= 2:
+        assert not np.array_equal(data["positions"][-1], data["positions"][-2]) \
+            or data["energies"][-1] != data["energies"][-2]
+    with pytest.raises(ValueError, match="interval"):
+        Relaxer(potential).relax(atoms, steps=1, traj_file=path, interval=0)

@@ -138,8 +138,14 @@ class Relaxer:
             res = self.potential.calculate(atoms)
 
         if obs is not None:
-            if last_recorded != it:  # final state, unless the loop-top
-                obs.record(res)      # record already captured it
+            # The loop-top record only captured the FINAL state on the
+            # converged break path (res unchanged since). On exhaustion the
+            # loop stepped again after the last record, so res (the returned
+            # final state) must always be appended — otherwise with
+            # interval=1 every non-converged relax saved a trajectory whose
+            # last frame != RelaxResult.energy.
+            if not (converged and last_recorded == it):
+                obs.record(res)
             obs.save(traj_file)
         return RelaxResult(
             atoms=atoms, converged=converged, nsteps=it, energy=res["energy"],

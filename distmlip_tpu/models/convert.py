@@ -13,6 +13,7 @@ producing a half-initialized model.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from typing import Callable
 
@@ -868,7 +869,35 @@ def escn_mapping(params, sd, model=None):
     torch oracle in tests/test_convert_escn.py is the golden contract.
     A ``backbone.`` prefix (whole-model UMA dumps) is handled; head
     tensors map onto the energy head when present.
+
+    Framework-local parameters (NOT populated from any checkpoint):
+    ``species_ref`` (per-element reference energies; fit via
+    ``train.fit_species_ref`` or leave zero) and ``mole_gate`` (the MOLE
+    expert-routing MLP — this framework routes on a psum-consistent
+    system composition + csd vector, escn_md.py:363-371, a different
+    input space from fairchem's routing net, so upstream routing weights
+    CANNOT be transplanted). A checkpoint that carries MOLE-routing
+    tensors is refused loudly below rather than converted into a model
+    whose expert mixtures would be silently random (ADVICE r4).
     """
+    # word-boundary match: "mole" as a standalone token (mole_coefficients,
+    # blocks.0.mole.net...) or any "routing" — NOT substrings of unrelated
+    # names like "molecule_embedding". Expert WEIGHTS (a leading expert
+    # axis on so2 tensors) are convertible and unaffected by this guard.
+    mole_keys = [k for k in sd
+                 if re.search(r"(?<![a-zA-Z])mole(?![a-zA-Z])", k,
+                              re.IGNORECASE)
+                 or "routing" in k.lower()]
+    if mole_keys:
+        raise ValueError(
+            f"state dict carries {len(mole_keys)} MOLE expert-routing "
+            f"tensors (first 5: {mole_keys[:5]}) which have no equivalent "
+            "here: this framework's expert gate (params['mole_gate']) "
+            "routes on system composition + csd and must be retrained "
+            "(train.py distillation recipe, PARITY.md 'UMA endgame'). "
+            "Remove the routing tensors from the dict to convert the "
+            "expert weights themselves — the resulting gate is "
+            "fresh-initialized, NOT the upstream routing.")
     p = "backbone." if any(k.startswith("backbone.") for k in sd) else ""
     cfg = model.cfg if model is not None else None
     n_blocks = len(params["blocks"])

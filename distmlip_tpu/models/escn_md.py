@@ -56,6 +56,12 @@ class ESCNMDConfig:
     hidden_channels: int = 64       # SO(2) conv hidden width
     edge_channels: int = 32         # species embeddings + rad_func hidden
     num_distance_basis: int = 64    # gaussian smearing resolution
+    # fairchem's GaussianSmearing(start, stop, num, basis_width_scalar) uses
+    # sigma = basis_width_scalar * offset spacing; the eSCN/equiformer_v2/UMA
+    # lineage constructs it with basis_width_scalar=2.0. The scalar is a
+    # module attr, NOT a checkpoint tensor, so conversion cannot recover it —
+    # it must match here by construction (PARITY.md calibration point).
+    basis_width_scalar: float = 2.0
     cutoff: float = 5.0
     avg_degree: float = 14.0        # edge-degree + message rescale factor
     num_experts: int = 1            # > 1: MOLE mixtures on SO(2) weights
@@ -327,9 +333,11 @@ class ESCNMD:
             radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask
             if cfg.use_envelope else lg.edge_mask.astype(positions.dtype)
         ).astype(dtype)
-        # gaussian smearing over [0, cutoff]
+        # gaussian smearing over [0, cutoff]; sigma = basis_width_scalar x
+        # center spacing (fairchem GaussianSmearing convention)
         centers = jnp.linspace(0.0, cfg.cutoff, cfg.num_distance_basis)
-        width = cfg.cutoff / (cfg.num_distance_basis - 1)
+        width = (cfg.basis_width_scalar * cfg.cutoff
+                 / (cfg.num_distance_basis - 1))
         gauss = jnp.exp(-0.5 * ((d[:, None] - centers) / width) ** 2
                         ).astype(dtype)
 

@@ -212,9 +212,17 @@ def config5():
     boundary exactly where DCN would sit; jax.devices() spans hosts by
     construction, so the same program runs unchanged on a real pod
     slice). Validates 16-way == 4-way at the north-star atom count; model
-    is CPU-mesh-sized (the real-chip shape is bench.py's)."""
+    is CPU-mesh-sized (the real-chip shape is bench.py's).
+
+    With DISTMLIP_REAL_DEVICES=1 this becomes the north-star TIMING run
+    instead: the full 1,000,188-atom box through the MP-0-faithful MACE
+    (128ch, l_max=a_lmax=3, correlation 3) in bfloat16 on ONE chip, edge-
+    chunked per the ROADMAP.md HBM budget, MD-style perturbed warm steps
+    (skin reuse), peak HBM printed. DISTMLIP_C5_EDGE_CHUNK /
+    DISTMLIP_C5_NODE_CHUNK trim the chunk sizes if the first attempt OOMs."""
     from distmlip_tpu.models import MACE, MACEConfig
 
+    real = bool(os.environ.get("DISTMLIP_REAL_DEVICES"))
     rng = np.random.default_rng(0)
     reps = int(os.environ.get("DISTMLIP_C5_REPS", "63"))
     unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
@@ -227,6 +235,32 @@ def config5():
     atoms = Atoms(numbers=numbers, positions=cart, cell=lattice)
     smap = np.full(9, -1, np.int32)
     smap[1], smap[6], smap[7], smap[8] = 0, 1, 2, 3
+
+    if real:
+        print(f"config 5: MACE, n_atoms = {len(atoms)}, SINGLE CHIP "
+              f"(MP-0-faithful bf16, north-star timing)")
+        cfg = MACEConfig(
+            num_species=4, channels=128, l_max=3, a_lmax=3, hidden_lmax=1,
+            correlation=3, num_interactions=2, num_bessel=8, radial_mlp=64,
+            cutoff=5.0, avg_num_neighbors=40.0, dtype="bfloat16", remat=True,
+            edge_chunk=int(os.environ.get("DISTMLIP_C5_EDGE_CHUNK", "32768")),
+            node_chunk=int(os.environ.get("DISTMLIP_C5_NODE_CHUNK", "4096")))
+        model = MACE(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        pot = DistPotential(model, params, num_partitions=1, species_map=smap,
+                            compute_stress=True, skin=0.5,
+                            compute_dtype="bfloat16")
+        for tag in ("cold", "warm", "warm", "warm"):
+            atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
+            t0 = time.time()
+            res = pot.calculate(atoms)
+            dt = time.time() - t0
+            print(f"single-chip {tag}: E={res['energy']:.2f} {dt:.2f}s "
+                  f"({len(atoms) / dt:.0f} atoms/s) "
+                  f"rebuilds={pot.rebuild_count}")
+        _print_hbm()
+        return
+
     print(f"config 5: MACE, n_atoms = {len(atoms)}, 16-way "
           f"(2-host x 8-chip proxy topology)")
 

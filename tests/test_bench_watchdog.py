@@ -109,6 +109,100 @@ def test_raise_after_claim_still_emits_json():
     assert "Traceback" in r.stderr
 
 
+def test_canary_healthy_path():
+    # a canary that exits 0 means the grant is healthy: claim proceeds
+    r = _run_snippet(
+        "import os, json\n"
+        "os.environ['BENCH_CANARY_LOG'] = '/tmp/bench_canary_test.log'\n"
+        "import bench\n"
+        "bench._CANARY_SRC = 'print(\"fake chip ok\")'\n"
+        "w = bench._Watchdog()\n"
+        "ok, detail = bench._canary_claim(w)\n"
+        "w.finish()\n"
+        "print(json.dumps({'ok': ok, 't': bench._TELEMETRY}))\n"
+    )
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True
+    assert out["t"]["canary"] == "ok"
+    assert out["t"]["probe_attempts"] == 1
+    assert out["t"]["wedge_suspected"] is False
+
+
+def test_canary_unavailable_retries_then_structured_failure():
+    # a canary that raises (UNAVAILABLE fast-fail) is retried, then the
+    # bench fails structured — the parent never starts its own claim
+    r = _run_snippet(
+        "import os, json\n"
+        "os.environ['BENCH_CANARY_LOG'] = '/tmp/bench_canary_test.log'\n"
+        "os.environ['BENCH_RETRIES'] = '2'\n"
+        "os.environ['BENCH_RETRY_BACKOFF_S'] = '0.1'\n"
+        "import bench\n"
+        "bench._CANARY_SRC = 'raise RuntimeError(\"UNAVAILABLE: sim\")'\n"
+        "w = bench._Watchdog()\n"
+        "ok, detail = bench._canary_claim(w)\n"
+        "w.finish()\n"
+        "print(json.dumps({'ok': ok, 'detail': detail, 't': bench._TELEMETRY}))\n"
+    )
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["ok"] is False
+    assert out["t"]["canary"] == "unavailable"
+    assert out["t"]["probe_attempts"] == 2
+    assert "UNAVAILABLE: sim" in out["detail"]
+
+
+def test_canary_hang_left_running_and_wedge_reported():
+    # the round-4 design point: a canary that neither exits nor fails is
+    # LEFT RUNNING (killing a mid-claim client renews the lease wedge) and
+    # the bench reports wedge_suspected without touching the backend itself
+    r = _run_snippet(
+        "import os, json\n"
+        "os.environ['BENCH_CANARY_LOG'] = '/tmp/bench_canary_test.log'\n"
+        "os.environ['BENCH_CLAIM_TIMEOUT_S'] = '3'\n"
+        "os.environ['BENCH_RETRIES'] = '1'\n"
+        "import bench\n"
+        "bench._CANARY_SRC = 'import time; time.sleep(120)'\n"
+        "w = bench._Watchdog()\n"
+        "ok, detail = bench._canary_claim(w)\n"
+        "w.finish()\n"
+        "print(json.dumps({'ok': ok, 'detail': detail, 't': bench._TELEMETRY}))\n",
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["ok"] is False
+    assert out["t"]["canary"] == "left_running"
+    assert out["t"]["wedge_suspected"] is True
+    assert "left running" in out["detail"]
+    pid = out["t"]["canary_pid"]
+    # the canary must still be alive after the parent gave up on it
+    os.kill(pid, 0)  # raises ProcessLookupError if it was killed
+    # clean up the orphaned sleeper (a real canary would be left alone;
+    # this one is a plain time.sleep, safe to reap)
+    import signal
+
+    os.kill(pid, signal.SIGKILL)
+
+
+def test_wedge_telemetry_present_on_watchdog_fire():
+    # artifact JSON must carry the wedge fields on the watchdog path too
+    r = _run_snippet(
+        "import time, bench\n"
+        "bench._TELEMETRY['probe_attempts'] = 2\n"
+        "bench._TELEMETRY['wedge_suspected'] = True\n"
+        "bench._TELEMETRY['canary'] = 'left_running'\n"
+        "w = bench._Watchdog()\n"
+        "w.phase('simulated hang', 1.5)\n"
+        "time.sleep(30)\n"
+    )
+    assert r.returncode == 0, r.stderr
+    parsed = json.loads(r.stdout.strip().splitlines()[-1])
+    assert parsed["wedge_suspected"] is True
+    assert parsed["probe_attempts"] == 2
+    assert parsed["canary"] == "left_running"
+
+
 def bench_metric():
     sys.path.insert(0, _REPO)
     import bench

@@ -564,3 +564,20 @@ def test_relaxer_traj_file(rng, potential, tmp_path):
             or data["energies"][-1] != data["energies"][-2]
     with pytest.raises(ValueError, match="interval"):
         Relaxer(potential).relax(atoms, steps=1, traj_file=path, interval=0)
+
+
+def test_relaxer_traj_file_nonconverged_has_final_frame(rng, potential,
+                                                        tmp_path):
+    """A relax that exhausts ``steps`` without converging must still save the
+    RETURNED final state as the trajectory's last frame. Regression for
+    ADVICE r4: with interval=1 the loop-top record at the last iteration
+    captured the PRE-step state and the post-loop record was skipped, so
+    energies[-1] != RelaxResult.energy on every non-converged relax."""
+    atoms = make_atoms(rng, noise=0.15)
+    path = str(tmp_path / "relax_nc.npz")
+    out = Relaxer(potential, fmax=1e-9).relax(  # unreachable fmax
+        atoms, steps=4, traj_file=path, interval=1)
+    assert not out.converged
+    data = np.load(path)
+    assert abs(float(data["energies"][-1]) - out.energy) < 1e-8
+    assert np.allclose(data["positions"][-1], out.atoms.positions)

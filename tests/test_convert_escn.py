@@ -239,7 +239,10 @@ def oracle_forward(sd, pos, species, src, dst, charge, spin, dataset):
     D = _wigner_t(rhat)
     env = _envelope_t(d)
     centers = torch.linspace(0.0, CUT, DB, dtype=torch.float64)
-    width = CUT / (DB - 1)
+    # fairchem GaussianSmearing: sigma = basis_width_scalar (2.0 in the
+    # eSCN/equiformer_v2/UMA lineage) x center spacing — the scalar is a
+    # module attr, not a checkpoint tensor (ADVICE r4 medium)
+    width = 2.0 * CUT / (DB - 1)  # hardcoded independently of ESCNMDConfig
     gauss = torch.exp(-0.5 * ((d[:, None] - centers) / width) ** 2)
 
     zemb = sd["backbone.sphere_embedding.weight"][species]
@@ -406,6 +409,33 @@ def test_mole_shaped_dict_converts():
     # every backbone tensor maps; only the (framework-side) MOLE gate has
     # no fairchem analogue in the synthetic dict
     assert report["unused_torch"] == []
+
+
+def test_mole_routing_tensors_refused_even_nonstrict():
+    """A dict carrying MOLE expert-ROUTING tensors must be refused loudly —
+    even under strict=False — because this framework's gate routes on
+    composition+csd and cannot host upstream routing weights; converting
+    around them would leave silently-random expert mixtures (ADVICE r4)."""
+    sd = synthetic_escn_state_dict()
+    sd["backbone.mole_coefficient_net.0.weight"] = torch.randn(
+        4, 8, dtype=torch.float64)
+    model = ESCNMD(CFG)
+    params = model.init(jax.random.PRNGKey(2))
+    with pytest.raises(ValueError, match="routing"):
+        from_torch("escn", sd, params, model=model, strict=False)
+
+
+def test_mole_guard_word_boundary_no_false_positive():
+    """Keys merely CONTAINING 'mole' as a substring (molecule_embedding)
+    must not trip the routing refusal — they fall through to the normal
+    unused-tensor report."""
+    sd = synthetic_escn_state_dict()
+    sd["backbone.molecule_embedding.weight"] = torch.randn(
+        4, 8, dtype=torch.float64)
+    model = ESCNMD(CFG)
+    params = model.init(jax.random.PRNGKey(2))
+    _, report = from_torch("escn", sd, params, model=model, strict=False)
+    assert "backbone.molecule_embedding.weight" in report["unused_torch"]
 
 
 def test_export_roundtrip_converts(tmp_path):

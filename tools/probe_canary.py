@@ -1,0 +1,47 @@
+"""The ONE chip-probe implementation (claim + tiny matmul + marker).
+
+Used by bench.py as its disposable claim canary (subprocess) and by
+tools/tpu_probe_forever.sh as the probe body — a single file owns the
+/tmp/tpu_up marker contract so the bench canary and the battery trigger
+(tools/when_up.sh) can never desynchronize.
+
+Exit 0: grant healthy, marker written. Exit 1: claim raised (fast-fail,
+e.g. UNAVAILABLE). A HANG means the grant is wedged — callers must poll
+with a budget and LEAVE this process running on expiry (killing a
+mid-claim client renews the server-side lease wedge; round-3/4 lesson).
+"""
+
+import sys
+import time
+
+MARKER = "/tmp/tpu_up"
+
+
+def main() -> int:
+    t0 = time.time()
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        d = jax.devices()
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        s = float((x @ x).sum())
+    except Exception as e:  # noqa: BLE001 - backend init raises anything
+        print(f"{time.strftime('%H:%M:%S')} probe fast-failed after "
+              f"{time.time() - t0:.0f}s: {type(e).__name__}: {str(e)[:160]}",
+              flush=True)
+        return 1
+    line = (f"{time.strftime('%H:%M:%S')} PROBE OK after "
+            f"{time.time() - t0:.0f}s: {d[0].platform} "
+            f"{getattr(d[0], 'device_kind', '?')} {s}")
+    print(line, flush=True)
+    try:
+        with open(MARKER, "w") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,28 +9,13 @@
 # returns). So: probe with NO timeout. A fast-fail retries on a 3-min
 # cadence; a hang simply WAITS (kills nothing, holds no lease) until the
 # stale lease expires and the pending claim is granted. On success the
-# matmul runs, the marker is written, and the loop exits cleanly. Pair
-# with tools/when_up.sh.
+# probe body (tools/probe_canary.py — shared with bench.py's claim
+# canary) writes /tmp/tpu_up and the loop exits cleanly. Pair with
+# tools/when_up.sh, which relaunches this script whenever it consumes a
+# marker.
 rm -f /tmp/tpu_up
 while [ ! -f /tmp/tpu_up ]; do
-  python - <<'EOF' >> /tmp/tpu_watch.log 2>&1
-import time
-t0 = time.time()
-try:
-    import jax, jax.numpy as jnp
-    d = jax.devices()
-    x = jnp.ones((256, 256), jnp.bfloat16)
-    s = float((x @ x).sum())
-except Exception as e:
-    print(f"{time.strftime('%H:%M:%S')} probe fast-failed after "
-          f"{time.time() - t0:.0f}s: {type(e).__name__}: {str(e)[:120]}")
-    raise SystemExit(1)
-line = (f"{time.strftime('%H:%M:%S')} PROBE OK after "
-        f"{time.time() - t0:.0f}s: {d[0].platform} {d[0].device_kind} {s}")
-print(line)
-with open("/tmp/tpu_up", "w") as f:
-    f.write(line + "\n")
-EOF
+  python "$(dirname "$0")/probe_canary.py" >> /tmp/tpu_watch.log 2>&1
   [ -f /tmp/tpu_up ] && break
   sleep 180
 done

@@ -6,18 +6,31 @@ implementations/uma/escn_md.py:250-523: per-partition Wigner rotation
 matrices, SO(2) convolutions in the edge frame, MOLE mixture-of-linear-
 experts coefficients replicated into every partition, halo exchange between
 layers). Differences from the reference's CUDA/thread-pool design: the edge
-Wigner matrices are built on-device by the exact CG recursion
-(ops/so3.wigner_d_batch) instead of precomputed Jd tables, and the whole
-layer loop is one SPMD program.
+Wigner matrices are built on-device inside the jitted program (no host
+precompute/upload per graph), and the whole layer loop is one SPMD
+program.
+
+Round 5: the Wigner/rotation machinery is the SHARED core ``ops/so3_e3nn``
+(per-l Jd-table pipeline, e3nn y-polar basis, pole-safe angles,
+gauge-certified by tests/test_escn_md.py) — the same implementation
+ESCNMD uses, so there is exactly one edge-frame rotation path to
+maintain. What stays deliberately DIFFERENT between the two eSCN stacks
+is the SO(2) parameterization — this model is the performance-first
+variant (free-form per-|m| expert-stacked weights, any l_max <= 6, no
+upstream weight-layout constraints); ``escn_md.ESCNMD`` is the
+UMA-convertible variant (fairchem's exact fc_m0/so2_m_conv/RadialFunction
+layout for checkpoint ingestion). That split is the permanent contract:
+capability/perf here, parity there.
 
 Node features: h (N, S, C) — S = (l_max+1)^2 stacked real spherical-harmonic
-coefficients (l <= 6), channels LAST so C lands in the TPU lane dimension
-(S=9..49 in the lane axis would pad to 128 and inflate HBM traffic 2.6-14x;
-see the MACE channels-last note, models/mace.py). Each edge: rotate the sender
-features into the edge-aligned frame (edge direction -> z), run SO(2)
-convolutions (per-|m| channel-mixing linear maps with the (+m, -m) complex
-pair structure, which commutes with rotations about z), rotate back,
-aggregate on the owner partition, gated nonlinearity.
+coefficients (l <= 6) in the e3nn layout (per l, m = -l..l with the m=0
+polar-aligned slot at the block center), channels LAST so C lands in the
+TPU lane dimension (S=9..49 in the lane axis would pad to 128 and inflate
+HBM traffic 2.6-14x; see the MACE channels-last note, models/mace.py).
+Each edge: rotate the sender features into the edge-aligned frame, run
+SO(2) convolutions (per-|m| channel-mixing linear maps with the (+m, -m)
+complex pair structure, which commutes with rotations about the edge
+axis), rotate back, aggregate on the owner partition, gated nonlinearity.
 
 UMA MOLE: with num_experts > 1 the SO(2) weights are convex mixtures of
 expert weights with coefficients from a whole-system composition embedding —
@@ -36,7 +49,7 @@ import numpy as np
 from ..ops import radial
 from ..ops.nn import cast_params_subtrees, linear, linear_init, mlp, mlp_init
 from ..ops.segment import masked_segment_sum
-from ..ops.so3 import rotation_to_z, wigner_d_batch
+from ..ops.so3_e3nn import CoeffLayout, wigner_blocks_from_edges
 
 
 @dataclass(frozen=True)
@@ -84,42 +97,20 @@ def _l_slices(l_max):
     return out
 
 
-def _sh_local(l: int, m_signed: int) -> int:
-    """Within-block index of (l, m) in ops/so3's stacked SH layout.
-
-    All l follow the standard order (m = -l..l, index l + m; cos-like A_m
-    components at +m, sin-like B_m at -m) EXCEPT l=1, which keeps e3nn's
-    (x, y, z) order: x is the cos-like m=1, y the sin-like m=1, and z the
-    true m=0 (z-rotation-invariant) component. The SO(2) machinery must pair
-    by the TRUE m-structure or gauge invariance of the edge frames breaks at
-    l=1 (caught by the float64 l_max=6 rotation test, round 3).
-    """
-    if l == 1:
-        return {1: 0, -1: 1, 0: 2}[m_signed]
-    return l + m_signed
-
-
-def _m_index(l_max):
-    """For each m >= 0, the coefficient indices of (l, +m) and (l, -m)
-    in the stacked layout (block offset l^2 + convention-aware local)."""
-    idx = {}
-    for m in range(l_max + 1):
-        plus, minus = [], []
-        for l in range(m, l_max + 1):
-            plus.append(l * l + _sh_local(l, m))
-            minus.append(l * l + _sh_local(l, -m))
-        idx[m] = (np.array(plus), np.array(minus))
-    return idx
-
-
 class ESCN:
     supports_compute_dtype = True  # energy_fn honors cfg.dtype="bfloat16"
 
     def __init__(self, config: ESCNConfig = ESCNConfig()):
         if config.l_max > 6:
-            raise NotImplementedError("l_max > 6: extend ops/so3 normalizations")
+            raise NotImplementedError(
+                "l_max > 6: extend the SH tables backing ops/so3_e3nn.jd_np")
         self.cfg = config
-        self.m_idx = _m_index(config.l_max)
+        # shared-core layout (full, no mmax narrowing): per |m|, the stacked
+        # indices of the (l, +m) / (l, -m) pair over l = m..l_max — the
+        # complex pairs the SO(2) convolutions mix
+        lay = CoeffLayout(config.l_max)
+        self.m_idx = {m: (lay.plus_idx[m], lay.minus_idx[m])
+                      for m in range(config.l_max + 1)}
 
     # ---- parameters ----
     def init(self, key) -> dict:
@@ -190,21 +181,24 @@ class ESCN:
 
         vec = lg.edge_vectors(positions)
         d = jnp.linalg.norm(jnp.where(lg.edge_mask[:, None], vec, 1.0), axis=-1)
-        # rhat stays in the positions dtype: the Wigner CG recursion chains
-        # l_max einsums off rotation_to_z(rhat), which compounds bf16 error
-        # to percent level — D is built fp32 and downcast per-use in rotate()
+        # rhat stays in the positions dtype: the shared Wigner core
+        # (ops/so3_e3nn) builds its trig chains in fp32 regardless and D is
+        # downcast per-use in rotate()
         rhat = vec / jnp.maximum(d, 1e-9)[:, None]
         env = (radial.polynomial_cutoff(d, cfg.cutoff) * lg.edge_mask).astype(dtype)
         bessel = radial.spherical_bessel_basis(d, cfg.cutoff, cfg.num_bessel
                                                ).astype(dtype)
         sl = _l_slices(cfg.l_max)
 
-        def rotate(hvecs, D, transpose=False):
-            # hvecs: (E_c, S, C) in source frame -> rotated per l block
+        def rotate(hvecs, D, to_edge=False):
+            # hvecs: (E_c, S, C) rotated per l block. D comes from the
+            # shared core (lab-from-edge): plain D maps edge-frame
+            # coefficients to the lab frame, D^T (to_edge=True) maps lab
+            # features into the edge-aligned frame.
             parts = []
             for l in range(cfg.l_max + 1):
                 Dl = D[l].astype(hvecs.dtype)
-                if transpose:
+                if to_edge:
                     Dl = jnp.swapaxes(Dl, -1, -2)
                 parts.append(jnp.einsum("epq,eqc->epc", Dl, hvecs[:, sl[l], :]))
             return jnp.concatenate(parts, axis=1)
@@ -212,9 +206,10 @@ class ESCN:
         # --- edge-chunked scan over the per-edge pipeline ---------------
         # The edge-frame Wigner blocks (E, S, S) and rotated features
         # (E, S, C) are the memory giants of eSCN; both are rebuilt per
-        # chunk inside a lax.scan (the CG recursion is a few kFLOP/edge —
-        # noise next to the SO(2) GEMMs), so peak memory is O(chunk), not
-        # O(E). Scaffolding shared with MACE (ops/chunk.py).
+        # chunk inside a lax.scan (the Jd-pipeline build is 3 z-rotations
+        # + 2 constant matmuls per l — noise next to the SO(2) GEMMs), so
+        # peak memory is O(chunk), not O(E). Scaffolding shared with MACE
+        # (ops/chunk.py).
         from ..ops.chunk import (chunk_spec, chunked, pad_index, pad_rows,
                                  scan_accumulate)
 
@@ -231,7 +226,7 @@ class ESCN:
         # single-chunk path: build D once (fp32) and share it across the
         # edge-degree pass and every layer instead of per edge_scan call
         D_shared = (
-            wigner_d_batch(cfg.l_max, rotation_to_z(edge_xs[3][0]))
+            wigner_blocks_from_edges(cfg.l_max, edge_xs[3][0])
             if K == 1 else None
         )
 
@@ -246,7 +241,7 @@ class ESCN:
                 D = (
                     D_shared
                     if D_shared is not None
-                    else wigner_d_batch(cfg.l_max, rotation_to_z(rhatc))
+                    else wigner_blocks_from_edges(cfg.l_max, rhatc)
                 )
                 msg = per_chunk(srcc, dstc, maskc, D, besc, envc)
                 return (
@@ -307,9 +302,9 @@ class ESCN:
             )
             y_deg = jnp.zeros((w_deg.shape[0], S, C), dtype=dtype)
             for l in range(cfg.l_max + 1):
-                y_deg = y_deg.at[:, l * l + _sh_local(l, 0), :].set(
-                    w_deg[:, l, :])  # (l, m=0)
-            return rotate(y_deg, D, transpose=True) * envc[:, None, None]
+                # (l, m=0): e3nn block center, index l^2 + l
+                y_deg = y_deg.at[:, l * l + l, :].set(w_deg[:, l, :])
+            return rotate(y_deg, D) * envc[:, None, None]
 
         h = h + edge_scan(deg_chunk, (S, C)) * jnp.asarray(
             1.0 / cfg.avg_num_neighbors, dtype=dtype
@@ -341,7 +336,7 @@ class ESCN:
                 )
                 g_e = mlp(layer["edge_mlp"], ef) * envc[:, None]  # (E_c, C)
 
-                h_rot = rotate(h[srcc], D)  # (E_c, S, C)
+                h_rot = rotate(h[srcc], D, to_edge=True)  # (E_c, S, C)
                 # inject edge scalars into the l=0 channel
                 h_rot = h_rot.at[:, 0, :].add(g_e)
 
@@ -366,7 +361,7 @@ class ESCN:
                         y = y.at[:, plus, :].set(yp.reshape(-1, nl, C))
                         y = y.at[:, minus, :].set(ym.reshape(-1, nl, C))
 
-                return rotate(y, D, transpose=True) * envc[:, None, None]
+                return rotate(y, D) * envc[:, None, None]
 
             agg = edge_scan(so2_chunk, (S, C)) * inv_avg
 

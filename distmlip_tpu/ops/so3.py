@@ -323,75 +323,3 @@ def symmetric_coupling_basis(a_ls: tuple, l_out: int, nu: int):
     U = (S @ null.reshape(n_paths, dim_sym, d_out).transpose(1, 2, 0).reshape(
         dim_sym, -1)).reshape((S_A,) * nu + (d_out, n_paths))
     return _store(np.ascontiguousarray(U))
-
-
-# ---------------------------------------------------------------------------
-# Batched Wigner matrices on device (for eSCN-style edge-frame rotations).
-# ---------------------------------------------------------------------------
-
-def wigner_d_batch(l_max: int, R):
-    """Real Wigner matrices D_l for a batch of rotations R (..., 3, 3).
-
-    Returns {l: (..., 2l+1, 2l+1)}. D_1 equals R itself in this basis
-    (Y_1 = sqrt(3) (x, y, z)); higher l follow by the CG recursion
-    D_l = C^T (D_{l-1} x D_1) C with C = real_clebsch_gordan(l-1, 1, l),
-    whose columns are orthonormal (multiplicity one). Exact and jittable.
-    """
-    import jax.numpy as jnp
-
-    out = {0: jnp.ones(R.shape[:-2] + (1, 1), dtype=R.dtype)}
-    if l_max >= 1:
-        out[1] = R
-    for l in range(2, l_max + 1):
-        C = jnp.asarray(real_clebsch_gordan(l - 1, 1, l), dtype=R.dtype)
-        C = C / np.sqrt(2 * l + 1)  # orthonormal columns
-        out[l] = jnp.einsum(
-            "mnp,...mM,...nN,MNq->...pq", C, out[l - 1], out[1], C
-        ) * (2 * l + 1)
-    return out
-
-
-def rotation_to_z(u):
-    """Batch of rotation matrices R with R @ u = z_hat (..., 3) -> (..., 3, 3).
-
-    Exact for every u including u = -z (where the single-chart Rodrigues
-    formula is singular — the reference's eSCN handles this case explicitly
-    in its edge-rotation init). Two charts selected per edge:
-
-      z >= 0:  R = I + [v]_x + [v]_x^2 / (1 + z),  v = u x z_hat
-      z <  0:  R = chartA(Rx(pi) @ u) @ Rx(pi),    Rx(pi) = diag(1,-1,-1)
-
-    Both denominators are >= 1 on their half-space, so the construction is
-    numerically exact (orthogonal to machine precision) everywhere. The two
-    charts differ by a gauge rotation about z at the seam; eSCN's SO(2)
-    convolutions are gauge-equivariant, so model outputs are unaffected.
-    Used to align edge vectors with the z axis for SO(2) convolutions.
-    """
-    import jax.numpy as jnp
-
-    x, y, z = u[..., 0], u[..., 1], u[..., 2]
-    cond = z >= 0.0
-    eye = jnp.eye(3, dtype=u.dtype)
-
-    def chart(xc, yc, zc, denom):
-        # Rodrigues closed form: R = I + [v]_x + [v]_x^2 / (1 + c) rotates
-        # (xc, yc, zc) onto z, with v = u x z = (yc, -xc, 0) and c = zc.
-        vx, vy = yc, -xc
-        zero = jnp.zeros_like(xc)
-        K = jnp.stack([
-            jnp.stack([zero, zero, vy], axis=-1),
-            jnp.stack([zero, zero, -vx], axis=-1),
-            jnp.stack([-vy, vx, zero], axis=-1),
-        ], axis=-2)
-        K2 = jnp.einsum("...ij,...jk->...ik", K, K)
-        return eye + K + K2 / denom[..., None, None]
-
-    # clamp each chart's denominator on the half-space where it is unused so
-    # the inactive branch stays NaN-free under grad
-    one = jnp.ones_like(z)
-    R_a = chart(x, y, z, jnp.where(cond, 1.0 + z, one))
-    R_b = chart(x, -y, -z, jnp.where(cond, one, 1.0 - z))
-    # compose chart B with Rx(pi): R_b' @ (Rx(pi) @ u) = z  =>  (R_b' Rx(pi)) u = z
-    rx_pi = jnp.asarray(np.diag([1.0, -1.0, -1.0]), dtype=u.dtype)
-    R_b = jnp.einsum("...ij,jk->...ik", R_b, rx_pi)
-    return jnp.where(cond[..., None, None], R_a, R_b)

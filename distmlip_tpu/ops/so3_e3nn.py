@@ -122,16 +122,23 @@ def edge_angles(rhat, eps: float = 1e-4):
     return alpha, beta
 
 
-def wigner_blocks_from_edges(l_max: int, rhat):
+def wigner_blocks_from_edges(l_max: int, rhat, gamma=None):
     """Per-l lab-from-edge Wigner blocks for a batch of edge directions.
 
     Returns ``[D_0, ..., D_lmax]`` with ``D_l``: (E, 2l+1, 2l+1) in the
     edge-directions' dtype. ``D_l @ f_edge`` rotates edge-frame
     coefficients to the lab frame; ``D_l.T @ f_lab`` rotates into the
-    edge frame (the gauge angle gamma is fixed to 0 — the SO(2)
-    convolutions are exactly gauge-covariant, so any gauge gives
-    identical model output; fairchem instead carries the gamma of its
-    edge_rot_mat construction, reference escn_md.py:99-109).
+    edge frame.
+
+    ``gamma`` (default None = 0) is the per-edge gauge angle: the residual
+    rotation about the edge axis, D(alpha, beta, gamma) = X(alpha) J
+    X(beta) J X(gamma). The production path fixes gamma = 0 — the SO(2)
+    convolutions are exactly gauge-covariant, so any gauge gives identical
+    model output; fairchem instead carries the gamma implied by its
+    edge_rot_mat construction (reference escn_md.py:99-109).
+    tests/test_escn_md.py proves output invariance under random per-edge
+    gamma AND under the construction-derived gamma of a fairchem-style
+    edge frame, so the gamma=0 choice is certified, not assumed.
     """
     wdt = jnp.promote_types(rhat.dtype, jnp.float32)  # never bf16: the trig
     alpha, beta = edge_angles(rhat.astype(wdt))       # chains compound
@@ -140,7 +147,11 @@ def wigner_blocks_from_edges(l_max: int, rhat):
         J = jnp.asarray(jd_np(l), dtype=wdt)
         Xa = _z_rot_jnp(l, alpha)
         Xb = _z_rot_jnp(l, beta)
-        out.append(jnp.einsum("epq,qr,ers,st->ept", Xa, J, Xb, J))
+        D = jnp.einsum("epq,qr,ers,st->ept", Xa, J, Xb, J)
+        if gamma is not None:
+            Xg = _z_rot_jnp(l, jnp.asarray(gamma, dtype=wdt))
+            D = jnp.einsum("ept,etu->epu", D, Xg)
+        out.append(D)
     return out
 
 

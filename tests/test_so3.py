@@ -104,90 +104,3 @@ def test_cg_high_l(rng):
         C,
     )
     np.testing.assert_allclose(inv, C, atol=1e-8)
-
-
-def test_wigner_d_batch_high_l(rng):
-    import jax.numpy as jnp
-
-    R = random_rotation(rng)
-    D = so3.wigner_d_batch(4, jnp.asarray(R[None].astype(np.float32)))
-    Dref = so3.wigner_d_from_rotation(4, R)
-    np.testing.assert_allclose(np.asarray(D[4][0]), Dref, atol=1e-5)
-
-
-def test_rotation_to_z_everywhere(rng):
-    """rotation_to_z is an exact rotation for generic, +z, -z, and near--z
-    directions (the single-chart Rodrigues formula is singular at u = -z)."""
-    u = rng.normal(size=(64, 3))
-    u /= np.linalg.norm(u, axis=1, keepdims=True)
-    special = np.array([
-        [0.0, 0.0, 1.0],
-        [0.0, 0.0, -1.0],          # exact antiparallel
-        [1e-4, 0.0, -1.0],         # clamp band of the old formula
-        [0.0, -1e-5, -1.0],
-        [1.0, 0.0, 0.0],           # chart seam z = 0
-        [0.0, 1.0, 0.0],
-    ])
-    special /= np.linalg.norm(special, axis=1, keepdims=True)
-    u = np.vstack([u, special]).astype(np.float64)
-    R = np.asarray(so3.rotation_to_z(u))
-    # R is orthogonal with det +1
-    np.testing.assert_allclose(
-        np.einsum("nij,nkj->nik", R, R), np.broadcast_to(np.eye(3), R.shape),
-        atol=1e-6,
-    )
-    np.testing.assert_allclose(np.linalg.det(R), 1.0, atol=1e-6)
-    # R @ u = z_hat
-    z = np.einsum("nij,nj->ni", R, u)
-    np.testing.assert_allclose(z, np.broadcast_to([0.0, 0.0, 1.0], z.shape),
-                               atol=1e-6)
-
-
-def test_rotation_to_z_grad_finite(rng):
-    """Gradients through rotation_to_z stay finite on both charts."""
-    import jax
-    import jax.numpy as jnp
-
-    def f(v):
-        vv = v / jnp.linalg.norm(v, axis=-1, keepdims=True)
-        return jnp.sum(so3.rotation_to_z(vv) ** 2)
-
-    v = jnp.asarray([[0.3, -0.2, 0.9], [0.1, 0.2, -0.95], [1.0, 0.0, -1e-3]])
-    g = jax.grad(f)(v)
-    assert np.all(np.isfinite(np.asarray(g)))
-
-
-@pytest.mark.parametrize("l_out,nu", [(0, 2), (1, 2), (0, 3), (1, 3)])
-def test_symmetric_coupling_basis(rng, l_out, nu):
-    """U must be equivariant, totally symmetric in its input slots, have
-    orthonormal path columns, and respect parity selection."""
-    a_ls = (0, 1, 2)
-    U = so3.symmetric_coupling_basis(a_ls, l_out, nu)
-    assert U is not None
-    S_A = 9
-    n = U.shape[-1]
-    # orthonormal path columns
-    flat = U.reshape(-1, n)
-    np.testing.assert_allclose(flat.T @ flat, np.eye(n), atol=1e-10)
-    # total symmetry in the nu input slots
-    perm = list(range(1, nu)) + [0, nu, nu + 1]
-    np.testing.assert_allclose(U, U.transpose(perm), atol=1e-10)
-    # equivariance: (D_sym ⊗ D_out) U = U for a random rotation
-    R = random_rotation(rng)
-    D = np.zeros((S_A, S_A))
-    o = 0
-    for l in a_ls:
-        D[o:o + 2 * l + 1, o:o + 2 * l + 1] = so3.wigner_d_from_rotation(l, R)
-        o += 2 * l + 1
-    out = U
-    for ax in range(nu):
-        out = np.tensordot(D, out, axes=([1], [ax]))
-        out = np.moveaxis(out, 0, ax)
-    out = np.einsum("...dn,pd->...pn", out,
-                    so3.wigner_d_from_rotation(l_out, R))
-    np.testing.assert_allclose(out, U, atol=1e-8)
-    # parity: entries with odd total l vanish
-    lvals = np.concatenate([[l] * (2 * l + 1) for l in a_ls])
-    idx = np.indices(U.shape[:nu])
-    tot_l = sum(lvals[idx[i]] for i in range(nu)) + l_out
-    assert np.abs(U[(tot_l % 2) == 1]).max() < 1e-10

@@ -73,6 +73,29 @@ def test_edge_frame_alignment():
         np.testing.assert_allclose(D @ Yy, Yu, atol=1e-5)
 
 
+def test_wigner_blocks_gamma_is_pure_gauge():
+    """A per-edge gamma leaves the edge-alignment property intact (the
+    m=0 axis vector is z-rotation invariant) and composes as D0 @ X(gamma)
+    — the algebraic backbone of the model-level gauge-invariance tests."""
+    rng = np.random.default_rng(5)
+    u = rng.normal(size=(6, 3))
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    g = rng.uniform(0, 2 * np.pi, 6)
+    b0 = wigner_blocks_from_edges(3, jnp.asarray(u, jnp.float32))
+    bg = wigner_blocks_from_edges(3, jnp.asarray(u, jnp.float32),
+                                  gamma=jnp.asarray(g, jnp.float32))
+    yhat = np.array([0.0, 1.0, 0.0])
+    for l in range(4):
+        D0 = np.asarray(b0[l], dtype=np.float64)
+        Dg = np.asarray(bg[l], dtype=np.float64)
+        # still maps the polar axis's SH onto the edge's
+        np.testing.assert_allclose(Dg @ sh_e3nn_np(l, yhat),
+                                   sh_e3nn_np(l, u), atol=1e-5)
+        # and equals D(alpha, beta, 0) composed with the z-rotation
+        np.testing.assert_allclose(
+            Dg, D0 @ z_rot_np(l, g), atol=1e-5)
+
+
 def test_wigner_blocks_orthogonal():
     rng = np.random.default_rng(11)
     u = rng.normal(size=(5, 3))

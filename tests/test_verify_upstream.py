@@ -1,0 +1,77 @@
+"""The one-command upstream-parity verifier must run its full pipeline
+(export passthrough -> config inference from shapes -> strict convert ->
+P=1/P=2 evaluation) on every family's synthetic checkpoint, exiting 3
+(= converted + self-consistent, upstream package not importable here).
+Wherever mace-torch / matgl / fairchem ARE installed the same command
+performs the numeric upstream comparison — the recipe in PARITY.md
+(VERDICT r4 item 6).
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+
+from distmlip_tpu.tools.verify_upstream import main as vu_main
+
+pytestmark = pytest.mark.slow
+
+
+def _npz(tmp_path, name, sd):
+    path = str(tmp_path / f"{name}.npz")
+    np.savez_compressed(
+        path, **{k: (v.detach().numpy() if hasattr(v, "detach") else v)
+                 for k, v in sd.items()})
+    return path
+
+
+def test_mace_dry_run(tmp_path):
+    from distmlip_tpu.models import MACE
+    from tests.test_convert import SMALL, synthetic_mace_state_dict
+
+    sd = synthetic_mace_state_dict(MACE(SMALL), np.random.default_rng(0))
+    assert vu_main(["mace", _npz(tmp_path, "mace", sd)]) == 3
+
+
+def test_chgnet_dry_run(tmp_path):
+    from tests.test_convert_chgnet import TCHGNet
+
+    torch.manual_seed(0)
+    sd = TCHGNet(5, 8, 6, 3, 2, 5.0, 3.0).state_dict()
+    assert vu_main(["chgnet", _npz(tmp_path, "chgnet", sd),
+                    "--set", "cutoff=5.0", "--set", "bond_cutoff=3.0"]) == 3
+
+
+def test_tensornet_dry_run(tmp_path):
+    from tests.test_convert_tensornet import TTensorNet
+
+    torch.manual_seed(0)
+    sd = TTensorNet(5, 8, 6, 2, 5.0).state_dict()
+    assert vu_main(["tensornet", _npz(tmp_path, "tensornet", sd),
+                    "--set", "cutoff=5.0"]) == 3
+
+
+def test_escn_dry_run(tmp_path):
+    from tests.test_convert_escn import synthetic_escn_state_dict
+
+    sd = synthetic_escn_state_dict()
+    assert vu_main(["escn", _npz(tmp_path, "escn", sd),
+                    "--set", "avg_degree=9.0"]) == 3
+
+
+def test_mace_inference_recovers_config(tmp_path):
+    """Shape-based inference must reproduce the generating config exactly
+    (l_max via path-count matching, hidden_lmax via contraction count,
+    correlation via U_matrix orders)."""
+    from distmlip_tpu.models import MACE
+    from distmlip_tpu.tools.verify_upstream import infer_mace
+    from tests.test_convert import SMALL, synthetic_mace_state_dict
+
+    sd = synthetic_mace_state_dict(MACE(SMALL), np.random.default_rng(0))
+    sd = {k: np.asarray(v) for k, v in sd.items()}
+    cfg, assumed, zs, _ = infer_mace(sd, {})
+    for field in ("num_species", "channels", "l_max", "a_lmax",
+                  "hidden_lmax", "correlation", "num_interactions",
+                  "num_bessel", "radial_mlp", "cutoff", "cutoff_p", "zbl"):
+        assert getattr(cfg, field) == getattr(SMALL, field), field

@@ -371,6 +371,7 @@ def _main_measured():
     print(_result_json(atoms_per_sec, _vs_baseline(atoms_per_sec),
                        dtype=bench_dtype, a_lmax=cfg.a_lmax))
     print(f"# n_atoms={len(atoms)} step={dt*1e3:.1f}ms rebuilds={pot.rebuild_count} "
+          f"prefetch_hits={pot.prefetch_hits} "
           f"(nl={pot.last_timings['neighbor_s']*1e3:.1f}ms "
           f"part={pot.last_timings['partition_s']*1e3:.1f}ms "
           f"dev={pot.last_timings['device_s']*1e3:.1f}ms) "

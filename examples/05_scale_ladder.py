@@ -247,9 +247,12 @@ def config5():
             node_chunk=int(os.environ.get("DISTMLIP_C5_NODE_CHUNK", "4096")))
         model = MACE(cfg)
         params = model.init(jax.random.PRNGKey(0))
+        # async_rebuild=False: a background prefetch would put a SECOND
+        # ~1M-atom graph on the chip while the first is live — this config
+        # runs within a few % of HBM capacity
         pot = DistPotential(model, params, num_partitions=1, species_map=smap,
                             compute_stress=True, skin=0.5,
-                            compute_dtype="bfloat16")
+                            compute_dtype="bfloat16", async_rebuild=False)
         for tag in ("cold", "warm", "warm", "warm"):
             atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
             t0 = time.time()

@@ -4,14 +4,22 @@ Every attribution/tuning tool must measure THIS workload, or its numbers
 describe a different program than the recorded benchmark.
 """
 
+import os
+
 import numpy as np
 
 
-def build_bench_atoms(reps=16, seed=0):
-    """bench.py's 4*reps^3-atom perturbed Si-like crystal (16 -> 16384)."""
+def build_bench_atoms(reps=None, seed=0):
+    """bench.py's 4*reps^3-atom perturbed Si-like crystal (16 -> 16384).
+
+    BENCH_REPS (the bench.py knob) overrides — so the attribution tools
+    can be smoke-tested at toy size on CPU without diverging from the
+    bench workload at full size."""
     from distmlip_tpu import geometry
     from distmlip_tpu.calculators import Atoms
 
+    if reps is None:
+        reps = int(os.environ.get("BENCH_REPS", "16"))
     rng = np.random.default_rng(seed)
     unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
     frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.9,

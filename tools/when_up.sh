@@ -104,6 +104,15 @@ persist
 python tools/tune_mace.py > /tmp/window/tune.jsonl 2> /tmp/window/tune.err
 rc=$?
 echo "$(date +%H:%M:%S) tune done rc=$rc" >> /tmp/window/log
+# headline A/B: the "dots" checkpoint policy (keep GEMM outputs resident
+# in the backward) beat full remat by ~23% in the CPU smoke — time the
+# exact bench artifact with it so the better policy can become the
+# default with on-chip evidence
+BENCH_REMAT=dots python bench.py > /tmp/window/bench_dots.json \
+  2> /tmp/window/bench_dots.err
+rc=$?
+echo "$(date +%H:%M:%S) bench(dots) done rc=$rc" >> /tmp/window/log
+persist
 python tools/profile_mace.py > /tmp/window/profile.jsonl \
   2> /tmp/window/profile.err
 rc=$?

@@ -141,6 +141,9 @@ class DistPotential:
                             #  numbers, cell, pbc, system)
         self.last_timings: dict[str, float] = {}
         self.rebuild_count = 0
+        import threading
+
+        self._count_lock = threading.Lock()
         # background-rebuild state (skin > 0 only): a single worker builds
         # the NEXT graph while the device steps on the current one
         self.async_rebuild = bool(async_rebuild) and self.skin > 0.0
@@ -266,7 +269,8 @@ class DistPotential:
             system=self._system(atoms),
         )
         graph = jax.device_put(graph, self._graph_shardings(graph))
-        self.rebuild_count += 1
+        with self._count_lock:  # prefetch thread increments concurrently
+            self.rebuild_count += 1
         return graph, host
 
     def _structure_matches(self, numbers0, cell0, pbc0, system0, atoms) -> bool:
@@ -384,8 +388,11 @@ class DistPotential:
         self._validate_system(self._system(atoms))
         prefetch_wait = 0.0
         if not self._cache_valid(atoms):
+            t_adopt = time.perf_counter()
             adopted = self._adopt_prefetch(atoms)
-            prefetch_wait = time.perf_counter() - t0  # join time, if any
+            # ONLY the adoption (possible future join) — not the validate/
+            # cache-scan above, whose O(N) cost belongs to neighbor_s
+            prefetch_wait = time.perf_counter() - t_adopt
             if adopted is not None:
                 # rebuild absorbed by the background thread: this step only
                 # pays a positions scatter, like a cache hit

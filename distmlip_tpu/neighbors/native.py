@@ -20,7 +20,12 @@ import numpy as np
 from .python_ref import NeighborList, neighbor_list_numpy
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
-_LIB_PATH = os.path.join(os.path.dirname(__file__), "_native.so")
+# DISTMLIP_TPU_NATIVE_LIB points the loader at an alternate build — the
+# sanitizer lane (make asan / make tsan in src/, see the Makefile) loads
+# _native_asan.so/_native_tsan.so through this
+_LIB_PATH = os.environ.get(
+    "DISTMLIP_TPU_NATIVE_LIB",
+    os.path.join(os.path.dirname(__file__), "_native.so"))
 _lock = threading.Lock()
 _lib = None
 _load_failed = False
@@ -33,8 +38,10 @@ def _build_and_load():
             return _lib
         try:
             srcs = [os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR) if f.endswith(".cpp")]
-            if not os.path.exists(_LIB_PATH) or any(
-                os.path.getmtime(s) > os.path.getmtime(_LIB_PATH) for s in srcs
+            if "DISTMLIP_TPU_NATIVE_LIB" not in os.environ and (
+                not os.path.exists(_LIB_PATH) or any(
+                    os.path.getmtime(s) > os.path.getmtime(_LIB_PATH)
+                    for s in srcs)
             ):
                 subprocess.run(
                     ["make", "-s", "-C", _SRC_DIR],

@@ -8,6 +8,8 @@ see SURVEY.md §7 "Hard parts").
 
 from __future__ import annotations
 
+import threading
+
 
 def round_capacity(n: int, slack: float = 1.2, multiple: int = 128) -> int:
     """Round ``n * slack`` up to a multiple (default 128 = TPU lane width)."""
@@ -18,16 +20,25 @@ def round_capacity(n: int, slack: float = 1.2, multiple: int = 128) -> int:
 
 
 class CapacityPolicy:
-    """Sticky capacities: grow in buckets, never shrink (per process)."""
+    """Sticky capacities: grow in buckets, never shrink (per process).
+
+    Thread-safe: DistPotential's background prefetch can build a graph
+    concurrently with a synchronous build (an abandoned stale prefetch);
+    an unlocked read-modify-write could store a SMALLER cap than a
+    concurrent build already used, breaking the never-shrink invariant
+    and triggering spurious recompiles."""
 
     def __init__(self, slack: float = 1.2, multiple: int = 128):
         self.slack = slack
         self.multiple = multiple
         self._caps: dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def get(self, name: str, needed: int) -> int:
-        cap = self._caps.get(name, 0)
-        if needed > cap:
-            cap = round_capacity(needed, self.slack, self.multiple)
-            self._caps[name] = cap
-        return cap
+        with self._lock:
+            cap = self._caps.get(name, 0)
+            if needed > cap:
+                cap = max(round_capacity(needed, self.slack, self.multiple),
+                          cap)
+                self._caps[name] = cap
+            return cap

@@ -140,10 +140,10 @@ class DistPotential:
         self._cache = None  # (graph, host, positions_sharding, build_pos,
                             #  numbers, cell, pbc, system)
         self.last_timings: dict[str, float] = {}
+        # graphs actually USED by a calculate() — synchronous builds plus
+        # ADOPTED background prefetches (both incremented on the main
+        # thread); discarded speculative builds don't count
         self.rebuild_count = 0
-        import threading
-
-        self._count_lock = threading.Lock()
         # background-rebuild state (skin > 0 only): a single worker builds
         # the NEXT graph while the device steps on the current one
         self.async_rebuild = bool(async_rebuild) and self.skin > 0.0
@@ -269,8 +269,6 @@ class DistPotential:
             system=self._system(atoms),
         )
         graph = jax.device_put(graph, self._graph_shardings(graph))
-        with self._count_lock:  # prefetch thread increments concurrently
-            self.rebuild_count += 1
         return graph, host
 
     def _structure_matches(self, numbers0, cell0, pbc0, system0, atoms) -> bool:
@@ -367,6 +365,7 @@ class DistPotential:
                           f"rebuilding synchronously", stacklevel=3)
             return None
         self.prefetch_hits += 1
+        self.rebuild_count += 1  # an adopted build IS a (hidden) rebuild
         return graph, host, snap
 
     def _install_cache(self, graph, host, build_atoms: Atoms):
@@ -400,6 +399,7 @@ class DistPotential:
                 self._install_cache(graph, host, snap)
             else:
                 graph, host = self._build_graph(atoms)
+                self.rebuild_count += 1
                 t1 = time.perf_counter()
                 self.last_build_fresh = True
                 if self.skin > 0.0:

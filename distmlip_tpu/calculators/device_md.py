@@ -154,10 +154,10 @@ class DeviceMD:
         max_chunk = int(max_chunk or steps)
         while remaining > 0:
             graph, host, positions = pot._prepare(atoms)
-            # fresh = built at the CURRENT positions this call (cache hits
+            # fresh = built at the CURRENT positions this call; cache hits
             # AND adopted background prefetches arrive with Verlet budget
-            # already spent; rebuild_count is useless here — the prefetch
-            # thread increments it asynchronously)
+            # already spent, so a rebuild-count delta (which counts both
+            # kinds of used graph) cannot distinguish them
             fresh = pot.last_build_fresh
             self.rebuilds += int(fresh)
             dtype = np.asarray(graph.lattice).dtype

@@ -74,8 +74,10 @@ print(f"MACE (converted, 4-way): E = {res['energy']:.4f} eV, "
 # The reference's flagship flow (uma_example.ipynb: from_existing around a
 # pretrained eSCNMDBackbone). ESCNMD mirrors that backbone tensor-for-tensor,
 # so a fairchem-named state dict converts with zero unmapped tensors; here a
-# synthetic UMA-shaped dict stands in (zero-egress image — export a real one
-# with tools/export_upstream.py where fairchem is installed).
+# synthetic UMA-shaped dict stands in (zero-egress image — where fairchem IS
+# installed, run the one-command check instead:
+#   python -m distmlip_tpu.tools.verify_upstream escn uma.pt
+# which exports, infers the config, converts, and compares E/F upstream).
 from distmlip_tpu.models import ESCNMD
 
 # the synthetic UMA-shaped dict lives beside the golden oracle and needs

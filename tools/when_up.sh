@@ -84,7 +84,19 @@ DISTMLIP_REAL_DEVICES=1 python examples/05_scale_ladder.py --config 4 \
 rc=$?
 echo "$(date +%H:%M:%S) ladder config 4 done rc=$rc" >> /tmp/window/log
 persist
-# north star: 1,000,188-atom MP-0-faithful MACE, one chip, bf16 + chunking
+# north star: 1,000,188-atom MP-0-faithful MACE, one chip, bf16 + chunking.
+# Pre-flight the never-before-run real branch at 16k atoms first so a
+# code-path failure costs seconds, not the 1M compile+step budget.
+DISTMLIP_REAL_DEVICES=1 DISTMLIP_C5_REPS=16 \
+  python examples/05_scale_ladder.py --config 5 \
+  > /tmp/window/ladder5_preflight.log 2>&1
+rc=$?
+echo "$(date +%H:%M:%S) ladder 5 preflight (16k) rc=$rc" >> /tmp/window/log
+if [ "$rc" -ne 0 ]; then
+  echo "$(date +%H:%M:%S) preflight failed — skipping the 1M attempt" \
+    >> /tmp/window/log
+  persist
+else
 DISTMLIP_REAL_DEVICES=1 python examples/05_scale_ladder.py --config 5 \
   > /tmp/window/ladder5_real.log 2>&1
 rc=$?
@@ -101,6 +113,7 @@ if [ "$rc" -ne 0 ] && grep -qi 'RESOURCE_EXHAUSTED\|out of memory' \
     >> /tmp/window/log
 fi
 persist
+fi  # preflight gate
 python tools/tune_mace.py > /tmp/window/tune.jsonl 2> /tmp/window/tune.err
 rc=$?
 echo "$(date +%H:%M:%S) tune done rc=$rc" >> /tmp/window/log

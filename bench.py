@@ -305,6 +305,7 @@ def _main_measured():
     from distmlip_tpu import geometry
     from distmlip_tpu.calculators import Atoms, DistPotential
     from distmlip_tpu.models import MACE, MACEConfig
+    from distmlip_tpu.telemetry import AggregatingSink, JsonlSink, Telemetry
 
     reps = int(os.environ.get("BENCH_REPS", "16"))
     steps = int(os.environ.get("BENCH_STEPS", "5"))
@@ -337,10 +338,17 @@ def _main_measured():
     )
     model = MACE(cfg)
     params = model.init(jax.random.PRNGKey(0))
+    # telemetry: per-phase aggregation always; JSONL artifact when
+    # BENCH_TELEMETRY_JSONL names a path (feed tools/telemetry_report.py)
+    agg = AggregatingSink()
+    telemetry = Telemetry([agg])
+    jsonl_path = os.environ.get("BENCH_TELEMETRY_JSONL")
+    if jsonl_path:
+        telemetry.add_sink(JsonlSink(jsonl_path))
     pot = DistPotential(model, params, num_partitions=len(jax.devices()),
                         compute_stress=True,
                         skin=float(os.environ.get("BENCH_SKIN", "0.5")),
-                        compute_dtype=bench_dtype)
+                        compute_dtype=bench_dtype, telemetry=telemetry)
     watchdog.n_atoms = len(atoms)
     watchdog.n_devices = len(jax.devices())
 
@@ -370,12 +378,15 @@ def _main_measured():
 
     print(_result_json(atoms_per_sec, _vs_baseline(atoms_per_sec),
                        dtype=bench_dtype, a_lmax=cfg.a_lmax))
-    print(f"# n_atoms={len(atoms)} step={dt*1e3:.1f}ms rebuilds={pot.rebuild_count} "
-          f"prefetch_hits={pot.prefetch_hits} "
-          f"(nl={pot.last_timings['neighbor_s']*1e3:.1f}ms "
-          f"part={pot.last_timings['partition_s']*1e3:.1f}ms "
-          f"dev={pot.last_timings['device_s']*1e3:.1f}ms) "
+    # the structured per-phase breakdown replaces the old hand-formatted
+    # pot.last_timings line; the same records went to the JSONL sink when
+    # BENCH_TELEMETRY_JSONL is set (render with tools/telemetry_report.py)
+    print(f"# n_atoms={len(atoms)} step={dt*1e3:.1f}ms "
+          f"rebuilds={pot.rebuild_count} prefetch_hits={pot.prefetch_hits} "
           f"devices={jax.devices()}", file=sys.stderr)
+    for line in agg.summary().splitlines():
+        print(f"# {line}", file=sys.stderr)
+    telemetry.close()
 
 
 if __name__ == "__main__":

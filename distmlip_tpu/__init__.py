@@ -64,3 +64,4 @@ def compute_dtype():
 
 
 from . import geometry  # noqa: E402,F401
+from . import telemetry  # noqa: E402,F401

@@ -40,7 +40,49 @@ import numpy as np
 from ..neighbors import neighbor_list
 from ..parallel import graph_mesh, make_potential_fn, make_site_fn
 from ..partition import CapacityPolicy, build_partitioned_graph, build_plan
+from ..telemetry import StepRecord, annotate
 from .atoms import EV_A3_TO_GPA, Atoms
+
+
+def _device_memory_stats() -> dict:
+    """Per-device ``bytes_in_use`` where the backend reports it (TPU/GPU;
+    CPU returns {}). Keys are ``dev<i>_bytes_in_use``-style."""
+    import jax
+
+    out = {}
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats and "bytes_in_use" in stats:
+                out[f"dev{d.id}_bytes_in_use"] = int(stats["bytes_in_use"])
+                if "peak_bytes_in_use" in stats:
+                    out[f"dev{d.id}_peak_bytes_in_use"] = int(
+                        stats["peak_bytes_in_use"])
+    except Exception:  # noqa: BLE001 - telemetry must never fail a step
+        return {}
+    return out
+
+
+def _discard_abandoned_build(future):
+    """Done-callback for an abandoned speculative build: free its device
+    buffers immediately (jax.Array.delete) instead of waiting for the
+    dropped Future to be garbage-collected. Runs on the rebuild worker
+    thread; the build was already abandoned, so nothing else can observe
+    the deleted arrays."""
+    if future.cancelled():
+        return
+    try:
+        graph, _host = future.result()
+    except Exception:  # noqa: BLE001 - speculative build failed; nothing held
+        return
+    import jax
+
+    for leaf in jax.tree.leaves(graph):
+        if hasattr(leaf, "delete"):
+            try:
+                leaf.delete()
+            except Exception:  # noqa: BLE001 - best-effort release
+                pass
 
 
 class DistPotential:
@@ -72,6 +114,7 @@ class DistPotential:
         compute_magmom: bool = False,
         async_rebuild: bool = True,
         prefetch_frac: float = 0.5,
+        telemetry=None,
     ):
         import jax
 
@@ -152,6 +195,21 @@ class DistPotential:
         self._prefetch = None   # (future, snapshot_atoms)
         self.prefetch_hits = 0  # rebuilds absorbed by a background build
         self.last_build_fresh = False  # _prepare built at current positions
+        # telemetry hub (distmlip_tpu.telemetry.Telemetry) or None; when
+        # unset (the default) no per-step record is ever constructed — the
+        # only residual instrumentation is `annotate()`, which returns a
+        # shared null context unless tracing is explicitly enabled
+        self.telemetry = telemetry
+        self._step_counter = 0
+        self._prepare_flags = {}  # cache-hit/rebuild/adoption of last _prepare
+
+    def attach_telemetry(self, telemetry) -> None:
+        """Attach a telemetry hub unless one is already installed (the
+        potential's own hub wins — drivers like MolecularDynamics/DeviceMD/
+        Relaxer route their ``telemetry=`` kwarg through here so the
+        precedence policy lives in one place)."""
+        if telemetry is not None and self.telemetry is None:
+            self.telemetry = telemetry
 
     def _init_runtime(self):
         self.mesh = (
@@ -256,19 +314,22 @@ class DistPotential:
         self.ensure_runtime(atoms)
         r_build = self.cutoff + self.skin
         b_build = (self.bond_cutoff + self.skin) if self.use_bond_graph else 0.0
-        nl = neighbor_list(
-            atoms.positions, atoms.cell, atoms.pbc, r_build,
-            bond_r=b_build, num_threads=self.num_threads,
-        )
-        plan = build_plan(
-            nl, atoms.cell, atoms.pbc, self.num_partitions, r_build,
-            b_build, self.use_bond_graph, grid=self.partition_grid,
-        )
-        graph, host = build_partitioned_graph(
-            plan, nl, self._species(atoms.numbers), atoms.cell, caps=self.caps,
-            system=self._system(atoms),
-        )
-        graph = jax.device_put(graph, self._graph_shardings(graph))
+        with annotate("distmlip/neighbor_build"):
+            nl = neighbor_list(
+                atoms.positions, atoms.cell, atoms.pbc, r_build,
+                bond_r=b_build, num_threads=self.num_threads,
+            )
+        with annotate("distmlip/partition"):
+            plan = build_plan(
+                nl, atoms.cell, atoms.pbc, self.num_partitions, r_build,
+                b_build, self.use_bond_graph, grid=self.partition_grid,
+            )
+            graph, host = build_partitioned_graph(
+                plan, nl, self._species(atoms.numbers), atoms.cell,
+                caps=self.caps, system=self._system(atoms),
+            )
+        with annotate("distmlip/graph_upload"):
+            graph = jax.device_put(graph, self._graph_shardings(graph))
         return graph, host
 
     def _structure_matches(self, numbers0, cell0, pbc0, system0, atoms) -> bool:
@@ -321,7 +382,12 @@ class DistPotential:
         instead of stalling the device through a host rebuild.
 
         Note: between the background device_put and adoption BOTH graphs
-        are device-resident. Within a few % of HBM capacity (the 1M-atom
+        are device-resident. The same 2x residency window exists on the
+        ABANDON path (structure changed / positions outran the snapshot's
+        budget): the in-flight build still completes its device_put, and
+        its arrays live until the done-callback installed by
+        ``_adopt_prefetch`` deletes the orphaned device buffers the moment
+        the build finishes. Within a few % of HBM capacity (the 1M-atom
         configs) construct with async_rebuild=False.
         """
         if not self.async_rebuild or self._prefetch is not None:
@@ -355,6 +421,11 @@ class DistPotential:
                                         self._system(snap), atoms)
                 and self._disp_frac(snap.positions, atoms.positions) < 1.0):
             future.cancel()  # no-op if already running; frees queued work
+            # a running build completes its device_put even when abandoned;
+            # eagerly delete the orphaned device buffers when it lands so
+            # transient 2x graph HBM residency ends at build completion,
+            # not at the Future's eventual garbage collection
+            future.add_done_callback(_discard_abandoned_build)
             return None
         try:
             graph, host = future.result()  # may block if still building
@@ -397,6 +468,8 @@ class DistPotential:
                 # pays a positions scatter, like a cache hit
                 graph, host, snap = adopted
                 self._install_cache(graph, host, snap)
+                self._prepare_flags = {"graph_reused": False, "rebuild": True,
+                                       "prefetch_adopted": True}
             else:
                 graph, host = self._build_graph(atoms)
                 self.rebuild_count += 1
@@ -409,17 +482,23 @@ class DistPotential:
                     "neighbor_s": t1 - t0 - prefetch_wait,
                     "partition_s": t2 - t1,
                     "prefetch_wait_s": prefetch_wait}
+                self._prepare_flags = {"graph_reused": False, "rebuild": True,
+                                       "prefetch_adopted": False}
                 return graph, host, graph.positions
+        else:
+            self._prepare_flags = {"graph_reused": True, "rebuild": False,
+                                   "prefetch_adopted": False}
         # shared warm path: valid cache OR freshly adopted prefetch
         self.last_build_fresh = False
         self._maybe_prefetch(atoms)
         graph, host, pos_sharding, *_ = self._cache
         t1 = time.perf_counter()
         dtype = np.asarray(graph.lattice).dtype
-        positions = host.scatter_global(
-            atoms.positions.astype(dtype), graph.n_cap
-        )
-        positions = jax.device_put(positions, pos_sharding)
+        with annotate("distmlip/positions_upload"):
+            positions = host.scatter_global(
+                atoms.positions.astype(dtype), graph.n_cap
+            )
+            positions = jax.device_put(positions, pos_sharding)
         t2 = time.perf_counter()  # partition_s bucket = positions upload
         # neighbor_s excludes the prefetch join so attribution tools never
         # mistake a background-build stall for neighbor-list cost
@@ -430,10 +509,12 @@ class DistPotential:
 
     def calculate(self, atoms: Atoms) -> dict:
         """Energy (eV), forces (eV/Å), stress (eV/Å^3, ASE sign convention)."""
+        t_start = time.perf_counter()
         graph, host, positions = self._prepare(atoms)
         t2 = time.perf_counter()
-        out = self._potential(self.params, graph, positions)
-        energy = float(out["energy"])
+        with annotate("distmlip/potential"):
+            out = self._potential(self.params, graph, positions)
+            energy = float(out["energy"])
         forces = host.gather_owned(np.asarray(out["forces"]), len(atoms))
         stress = np.asarray(out["stress"])
         result = {
@@ -446,10 +527,49 @@ class DistPotential:
         if self._site_fn is not None:
             # sitewise readout (CHGNet magmoms; reference ase.py magmoms
             # surface) over the SAME cached graph/positions
-            m = np.asarray(self._site_fn(self.params, graph, positions))
+            with annotate("distmlip/site_readout"):
+                m = np.asarray(self._site_fn(self.params, graph, positions))
             result["magmoms"] = host.gather_owned(m, len(atoms))
         self.last_timings["device_s"] = time.perf_counter() - t2
+        self._emit_record("calculate", host,
+                          total_s=time.perf_counter() - t_start)
         return result
+
+    def _emit_record(self, kind: str, host, total_s: float,
+                     extra_timings: dict | None = None,
+                     cache_size_fn=None, **extra) -> None:
+        """Build and emit a StepRecord; a no-op (no record constructed)
+        unless a telemetry hub with sinks is attached. ``cache_size_fn``
+        lets a caller that dispatches its own jitted program (DeviceMD's
+        chunk stepper) attribute compiles to THAT program instead of the
+        potential; deltas are tracked per kind so the two never conflate."""
+        self._step_counter += 1
+        tel = self.telemetry
+        if tel is None or not tel.wants_records():
+            return
+        cache_size = 0
+        compiled = False
+        size_fn = cache_size_fn or getattr(self._potential, "_cache_size", None)
+        if size_fn is not None:
+            cache_size = int(size_fn())
+            last = getattr(self, "_last_cache_sizes", None)
+            if last is None:
+                last = self._last_cache_sizes = {}
+            compiled = cache_size > last.get(kind, 0)
+            last[kind] = cache_size
+        timings = {**self.last_timings, "total_s": total_s,
+                   **(extra_timings or {})}
+        rec = StepRecord(
+            step=self._step_counter, kind=kind, timings=timings,
+            compile_cache_size=cache_size, compiled=compiled,
+            device_memory=_device_memory_stats(),
+            extra=extra, **self._prepare_flags,
+        )
+        stats = getattr(host, "stats", None)
+        if stats:
+            for k, v in stats.items():
+                setattr(rec, k, v)
+        tel.emit(rec)
 
     def partition_report(self, atoms: Atoms) -> str:
         """Partition-balance diagnostics (reference dist.py:704-721)."""

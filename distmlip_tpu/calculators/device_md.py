@@ -121,11 +121,14 @@ class DeviceMD:
     """
 
     def __init__(self, potential, atoms: Atoms, timestep: float = 1.0,
-                 temperature: float | None = None, taut: float = 100.0):
+                 temperature: float | None = None, taut: float = 100.0,
+                 telemetry=None):
         from ..parallel.runtime import make_total_energy
 
         if potential.skin <= 0.0:
             raise ValueError("DeviceMD requires DistPotential(skin > 0)")
+        if telemetry is not None:
+            getattr(potential, "attach_telemetry", lambda t: None)(telemetry)
         potential.ensure_runtime(atoms)  # AUTO partitioning needs the cell
         self.pot = potential
         self.atoms = atoms
@@ -147,12 +150,15 @@ class DeviceMD:
         import jax
         import jax.numpy as jnp
 
+        import time
+
         pot, atoms = self.pot, self.atoms
         remaining = int(steps)
         if remaining <= 0:
             return
         max_chunk = int(max_chunk or steps)
         while remaining > 0:
+            t_chunk = time.perf_counter()
             graph, host, positions = pot._prepare(atoms)
             # fresh = built at the CURRENT positions this call; cache hits
             # AND adopted background prefetches arrive with Verlet budget
@@ -174,13 +180,27 @@ class DeviceMD:
                 atoms.masses.astype(dtype), graph.n_cap, fill=1.0
             )
             n = jnp.int32(min(remaining, max_chunk))
+            t_dev = time.perf_counter()
             pos_f, vel_f, f_f, done, e_f, ke = self._stepper(
                 pot.params, graph, positions, ref, vel, masses, n,
                 jnp.float32(self.taut),
                 jnp.float32(self.temperature or 0.0),
             )
-            done = int(done)
+            done = int(done)  # blocks on the chunk; device_s is real
+            t_done = time.perf_counter()
+
+            def emit_chunk(**extra):
+                pot._emit_record(
+                    "md_chunk", host,
+                    total_s=time.perf_counter() - t_chunk,
+                    extra_timings={"device_s": t_done - t_dev},
+                    cache_size_fn=getattr(self._stepper, "_cache_size", None),
+                    steps_done=done, steps_total=self.steps_done, **extra)
             if done == 0:
+                # record the wasted dispatch either way: repeated
+                # zero-progress retries are exactly the pathology
+                # telemetry exists to surface
+                emit_chunk(zero_progress=True, fresh_build=fresh)
                 if not fresh:
                     # warm cache arrived with most of the skin budget spent;
                     # rebuild at the current positions and retry
@@ -207,4 +227,8 @@ class DeviceMD:
             self.energies.append(float(e_f))
             self.steps_done += done
             remaining -= done
+            # one record per device chunk: device_s covers the whole jitted
+            # while_loop (`done` steps), so mean per-step cost is
+            # device_s / steps_done
+            emit_chunk()
         self.results = {"energy": self.energies[-1], "kinetic": float(ke)}

@@ -82,9 +82,14 @@ class MolecularDynamics:
         trajectory: TrajectoryObserver | None = None,
         logfile: str | None = None,
         loginterval: int = 1,
+        telemetry=None,
     ):
         if ensemble not in ENSEMBLES:
             raise ValueError(f"ensemble {ensemble!r} not in {ENSEMBLES}")
+        # attach the telemetry hub to the potential so every step's
+        # calculate() emits a StepRecord
+        if telemetry is not None:
+            getattr(potential, "attach_telemetry", lambda t: None)(telemetry)
         if ensemble.startswith("npt") and not getattr(potential, "compute_stress", True):
             raise ValueError(
                 "NPT ensembles need stresses: build the potential with "

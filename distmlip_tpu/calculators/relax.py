@@ -59,9 +59,13 @@ class Relaxer:
         f_alpha: float = 0.99,
         maxstep: float = 0.2,        # trust radius, Å per component
         cell_factor: float | None = None,  # None -> len(atoms), balances cell vs position DOFs
+        telemetry=None,
     ):
         if optimizer not in _OPTIMIZERS:
             raise ValueError(f"optimizer {optimizer!r} not in {_OPTIMIZERS}")
+        # per-step StepRecords flow through the potential's calculate()
+        if telemetry is not None:
+            getattr(potential, "attach_telemetry", lambda t: None)(telemetry)
         if cell_filter not in ("unit", "exp"):
             raise ValueError(f"cell_filter {cell_filter!r} not in ('unit', 'exp')")
         self.potential = potential

@@ -20,21 +20,33 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..telemetry import scope
+
+if hasattr(lax, "axis_size"):  # jax >= 0.6
+    _axis_size = lax.axis_size
+else:  # 0.4.x: axis_frame(name) resolves to the (static) size
+    def _axis_size(axis_name):
+        frame = jax.core.axis_frame(axis_name)
+        return getattr(frame, "size", frame)
+
 
 def _exchange(feats, send_idx, send_mask, recv_idx, shifts, axis_name):
     """One round of halo exchange on a local feature array (N_cap, ...)."""
     if not shifts or axis_name is None:
         return feats
-    n_dev = lax.axis_size(axis_name)
+    n_dev = _axis_size(axis_name)
     for si, shift in enumerate(shifts):
-        idx = send_idx[si]
-        mask = send_mask[si]
-        payload = feats[idx]
-        m = mask.astype(feats.dtype).reshape(mask.shape + (1,) * (feats.ndim - 1))
-        payload = payload * m
-        perm = [(p, (p + shift) % n_dev) for p in range(n_dev)]
-        received = lax.ppermute(payload, axis_name, perm)
-        feats = feats.at[recv_idx[si]].set(received, mode="drop")
+        with scope(f"halo/shift{shift}"):
+            idx = send_idx[si]
+            mask = send_mask[si]
+            payload = feats[idx]
+            m = mask.astype(feats.dtype).reshape(
+                mask.shape + (1,) * (feats.ndim - 1))
+            payload = payload * m
+            perm = [(p, (p + shift) % n_dev) for p in range(n_dev)]
+            with scope("ppermute"):
+                received = lax.ppermute(payload, axis_name, perm)
+            feats = feats.at[recv_idx[si]].set(received, mode="drop")
     return feats
 
 
@@ -80,19 +92,21 @@ class LocalGraph:
     # ---- collectives ----
     def halo_exchange(self, feats):
         """Refresh halo (from-section) rows of a node feature array."""
-        return _exchange(
-            feats, self.halo_send_idx, self.halo_send_mask, self.halo_recv_idx,
-            self.shifts, self.axis_name,
-        )
+        with scope("halo_exchange"):
+            return _exchange(
+                feats, self.halo_send_idx, self.halo_send_mask,
+                self.halo_recv_idx, self.shifts, self.axis_name,
+            )
 
     def bond_halo_exchange(self, feats):
         """Refresh halo rows of a bond-node feature array."""
         if not self.has_bond_graph:
             return feats
-        return _exchange(
-            feats, self.bond_halo_send_idx, self.bond_halo_send_mask,
-            self.bond_halo_recv_idx, self.shifts, self.axis_name,
-        )
+        with scope("bond_halo_exchange"):
+            return _exchange(
+                feats, self.bond_halo_send_idx, self.bond_halo_send_mask,
+                self.bond_halo_recv_idx, self.shifts, self.axis_name,
+            )
 
     def psum(self, x):
         if self.axis_name is None:
@@ -109,26 +123,32 @@ class LocalGraph:
     # ---- bond-graph index remaps (reference dist.py:635-702 analogue) ----
     def edge_to_bond(self, edge_feats, bond_feats):
         """Seed owned bond-node rows from their atom-graph edge features."""
-        vals = edge_feats[self.bond_map_edge]
-        m = self.bond_map_mask
-        vals = vals * m.astype(vals.dtype).reshape(m.shape + (1,) * (vals.ndim - 1))
-        idx = jnp.where(m, self.bond_map_bond, self.b_cap)
-        return bond_feats.at[idx].set(vals, mode="drop")
+        with scope("edge_to_bond"):
+            vals = edge_feats[self.bond_map_edge]
+            m = self.bond_map_mask
+            vals = vals * m.astype(vals.dtype).reshape(
+                m.shape + (1,) * (vals.ndim - 1))
+            idx = jnp.where(m, self.bond_map_bond, self.b_cap)
+            return bond_feats.at[idx].set(vals, mode="drop")
 
     def bond_to_edge(self, bond_feats, edge_feats):
         """Write owned bond-node features back onto their edges."""
-        vals = bond_feats[self.bond_map_bond]
-        m = self.bond_map_mask
-        vals = vals * m.astype(vals.dtype).reshape(m.shape + (1,) * (vals.ndim - 1))
-        idx = jnp.where(m, self.bond_map_edge, self.e_cap)
-        return edge_feats.at[idx].set(vals, mode="drop")
+        with scope("bond_to_edge"):
+            vals = bond_feats[self.bond_map_bond]
+            m = self.bond_map_mask
+            vals = vals * m.astype(vals.dtype).reshape(
+                m.shape + (1,) * (vals.ndim - 1))
+            idx = jnp.where(m, self.bond_map_edge, self.e_cap)
+            return edge_feats.at[idx].set(vals, mode="drop")
 
     # ---- reductions ----
     def owned_sum(self, per_atom):
         """Sum a per-atom quantity over owned nodes, reduced across the mesh."""
-        m = self.owned_mask.astype(per_atom.dtype)
-        local = jnp.sum(per_atom * m.reshape(m.shape + (1,) * (per_atom.ndim - 1)))
-        return self.psum(local)
+        with scope("owned_sum"):
+            m = self.owned_mask.astype(per_atom.dtype)
+            local = jnp.sum(
+                per_atom * m.reshape(m.shape + (1,) * (per_atom.ndim - 1)))
+            return self.psum(local)
 
 
 def local_graph_from_stacked(g, axis_name: str | None) -> tuple[LocalGraph, Any]:

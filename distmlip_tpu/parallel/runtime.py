@@ -22,6 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..geometry import apply_strain
 from ..partition.graph import PartitionedGraph
+from ..telemetry import scope
 from .halo import local_graph_from_stacked
 from .mesh import GRAPH_AXIS
 
@@ -29,6 +30,14 @@ try:  # jax >= 0.4.35 exposes shard_map at top level
     shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
+
+# the "don't require replication-invariance checks" kwarg was renamed
+# check_rep -> check_vma across jax versions; detect which one this build has
+import inspect as _inspect
+
+_CHECK_KW = ("check_vma" if "check_vma"
+             in _inspect.signature(shard_map).parameters else "check_rep")
+_NO_CHECK = {_CHECK_KW: False}
 
 
 def graph_in_specs(graph: PartitionedGraph) -> PartitionedGraph:
@@ -67,11 +76,13 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None):
         axis = GRAPH_AXIS if mesh is not None else None
         lg, _ = local_graph_from_stacked(graph_local, axis)
         dtype = positions.dtype
-        pos, lg.lattice = apply_strain(
-            positions[0], lg.lattice.astype(dtype), strain.astype(dtype)
-        )
+        with scope("apply_strain"):
+            pos, lg.lattice = apply_strain(
+                positions[0], lg.lattice.astype(dtype), strain.astype(dtype)
+            )
         pos = lg.halo_exchange(pos)
-        e_atoms = model_energy_fn(params, lg, pos)
+        with scope("model_energy"):
+            e_atoms = model_energy_fn(params, lg, pos)
         return lg.owned_sum(e_atoms.reshape(-1, 1))
 
     if mesh is None:
@@ -90,7 +101,7 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None):
             mesh=mesh,
             in_specs=(P(), P(), graph_in_specs(graph), P(GRAPH_AXIS)),
             out_specs=P(),
-            check_vma=False,
+            **_NO_CHECK,
         )
         return sharded(params, strain, graph, positions)
 
@@ -113,7 +124,8 @@ def make_site_fn(model_site_fn, mesh: Mesh | None):
         axis = GRAPH_AXIS if mesh is not None else None
         lg, _ = local_graph_from_stacked(graph_local, axis)
         pos = lg.halo_exchange(positions[0])
-        return model_site_fn(params, lg, pos)[None]
+        with scope("model_site"):
+            return model_site_fn(params, lg, pos)[None]
 
     if mesh is None:
         @jax.jit
@@ -132,7 +144,7 @@ def make_site_fn(model_site_fn, mesh: Mesh | None):
             mesh=mesh,
             in_specs=(P(), graph_in_specs(graph), P(GRAPH_AXIS)),
             out_specs=P(GRAPH_AXIS),
-            check_vma=False,
+            **_NO_CHECK,
         )
         return sharded(params, graph, positions)
 
@@ -151,16 +163,20 @@ def make_potential_fn(model_energy_fn, mesh: Mesh | None, compute_stress: bool =
     def potential(params, graph, positions):
         strain = jnp.zeros((3, 3), dtype=positions.dtype)
         if compute_stress:
-            (energy, (g_pos, g_strain)) = jax.value_and_grad(
-                total_energy, argnums=(2, 3)
-            )(params, graph, positions, strain)
-            vol = jnp.abs(jnp.linalg.det(graph.lattice.astype(jnp.float64 if
-                          graph.lattice.dtype == jnp.float64 else positions.dtype)))
-            stress = g_strain / vol
+            with scope("energy_and_grad"):
+                (energy, (g_pos, g_strain)) = jax.value_and_grad(
+                    total_energy, argnums=(2, 3)
+                )(params, graph, positions, strain)
+            with scope("stress"):
+                vol = jnp.abs(jnp.linalg.det(graph.lattice.astype(
+                    jnp.float64 if graph.lattice.dtype == jnp.float64
+                    else positions.dtype)))
+                stress = g_strain / vol
         else:
-            energy, g_pos = jax.value_and_grad(total_energy, argnums=2)(
-                params, graph, positions, strain
-            )
+            with scope("energy_and_grad"):
+                energy, g_pos = jax.value_and_grad(total_energy, argnums=2)(
+                    params, graph, positions, strain
+                )
             stress = jnp.zeros((3, 3), dtype=positions.dtype)
         return {"energy": energy, "forces": -g_pos, "stress": stress}
 

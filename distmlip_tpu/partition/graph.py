@@ -102,6 +102,9 @@ class HostGraphData:
     plan: PartitionPlan
     global_ids: list = field(default_factory=list)
     owned_counts: np.ndarray | None = None
+    # shape/occupancy/halo-volume stats captured at build time (host numpy,
+    # before device_put) — the telemetry StepRecord's graph fields
+    stats: dict | None = None
 
     def scatter_global(self, global_arr: np.ndarray, n_cap: int, fill=0.0) -> np.ndarray:
         """Split a (N, ...) global array into padded (P, N_cap, ...) locals."""
@@ -332,5 +335,37 @@ def build_partitioned_graph(
             "dataset": np.int32((system or {}).get("dataset", 0)),
         },
     )
-    host = HostGraphData(plan=plan, global_ids=plan.global_ids, owned_counts=owned_counts)
+    host = HostGraphData(plan=plan, global_ids=plan.global_ids,
+                         owned_counts=owned_counts,
+                         stats=graph_build_stats(graph))
     return graph, host
+
+
+def graph_build_stats(graph: PartitionedGraph) -> dict:
+    """Shape/occupancy/halo-volume stats from a host-side (numpy) graph.
+
+    Called at build time, BEFORE device_put, so reading the masks costs a
+    few O(P*cap) numpy sums on arrays already in cache — never a device
+    transfer. Keys mirror StepRecord's graph fields.
+    """
+    nodes = np.asarray(graph.node_mask).sum(axis=1)
+    edges = np.asarray(graph.edge_mask).sum(axis=1)
+    send = np.asarray(graph.halo_send_mask).sum(axis=(0, 2))
+    recv = (np.asarray(graph.halo_recv_idx) < graph.n_cap).sum(axis=(0, 2))
+    stats = {
+        "n_atoms": int(graph.n_total_nodes),
+        "num_partitions": graph.num_partitions,
+        "n_cap": graph.n_cap,
+        "e_cap": graph.e_cap,
+        "b_cap": graph.b_cap,
+        "n_nodes_per_part": [int(x) for x in nodes],
+        "n_edges_per_part": [int(x) for x in edges],
+        "node_occupancy": float(nodes.max() / graph.n_cap) if graph.n_cap else 0.0,
+        "edge_occupancy": float(edges.max() / graph.e_cap) if graph.e_cap else 0.0,
+        "halo_send_per_part": [int(x) for x in send],
+        "halo_recv_per_part": [int(x) for x in recv],
+    }
+    if graph.has_bond_graph:
+        bsend = np.asarray(graph.bond_halo_send_mask).sum(axis=(0, 2))
+        stats["bond_halo_send_per_part"] = [int(x) for x in bsend]
+    return stats

@@ -1,0 +1,44 @@
+"""Telemetry: structured step records, trace annotations, pluggable sinks.
+
+One pipeline replaces the ad-hoc timing that used to live in
+``utils.profiling.StepTimer`` + ``DistPotential.last_timings``:
+
+- ``StepRecord`` — the typed per-step schema (timings, graph shape,
+  capacity occupancy, halo volumes, cache behavior, device memory);
+- ``Telemetry`` + sinks (``AggregatingSink``, ``JsonlSink``,
+  ``StderrSummarySink``) — where records go;
+- ``annotate``/``scope``/``device_trace`` — xprof timeline names on the
+  host and jit hot paths;
+- ``report`` — offline aggregation of a JSONL run
+  (``tools/telemetry_report.py``).
+
+Quick start::
+
+    from distmlip_tpu.telemetry import Telemetry, JsonlSink, AggregatingSink
+
+    tel = Telemetry([JsonlSink("run.jsonl"), AggregatingSink()])
+    pot = DistPotential(model, params, telemetry=tel)
+    ...  # run MD / relax / calculate
+    print(tel.sinks[1].summary())
+    tel.close()
+"""
+
+from .record import PHASE_KEYS, StepRecord
+from .sinks import (AggregatingSink, JsonlSink, StderrSummarySink, Telemetry,
+                    TelemetrySink)
+from .trace import annotate, device_trace, scope, set_tracing, tracing_enabled
+
+__all__ = [
+    "PHASE_KEYS",
+    "StepRecord",
+    "Telemetry",
+    "TelemetrySink",
+    "AggregatingSink",
+    "JsonlSink",
+    "StderrSummarySink",
+    "annotate",
+    "scope",
+    "device_trace",
+    "set_tracing",
+    "tracing_enabled",
+]

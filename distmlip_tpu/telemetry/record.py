@@ -1,0 +1,166 @@
+"""Typed per-step telemetry records.
+
+``StepRecord`` is the one schema every producer (DistPotential, DeviceMD,
+MolecularDynamics, Relaxer, bench.py) emits and every sink consumes. It
+replaces the untyped ``last_timings`` dicts: a record carries the per-phase
+host timings, the graph shape and capacity/padding occupancy, per-partition
+halo send/recv volumes, compile-cache and graph-cache hit/miss flags, and
+device memory stats where the backend reports them (TPU; CPU returns none).
+
+The reference implementation's analogue is the ad-hoc C TIMING macros +
+torch.profiler ranges (SURVEY.md §5); both papers this repo tracks
+(arXiv:2504.16068, arXiv:2504.10700) key their analyses on exactly this
+per-phase / per-partition breakdown.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from dataclasses import dataclass, field
+
+# Phase keys every consumer can rely on (sinks/report treat unknown phases
+# generically, so producers may add more).
+PHASE_KEYS = (
+    "neighbor_s",       # host neighbor-list build (excl. prefetch join)
+    "partition_s",      # plan + pad + device_put (warm path: positions upload)
+    "prefetch_wait_s",  # time spent joining an in-flight background build
+    "device_s",         # jitted potential dispatch + result fetch
+    "total_s",          # whole calculate()/chunk wall time
+)
+
+
+@dataclass
+class StepRecord:
+    """One step (or device-MD chunk) of a distributed-potential workload."""
+
+    # --- identity ---
+    step: int = 0                    # producer-local step counter
+    kind: str = "calculate"          # calculate | md_chunk | relax_step | ...
+    t_wall: float = field(default_factory=time.time)  # unix seconds
+
+    # --- per-phase host timings (seconds) ---
+    timings: dict[str, float] = field(default_factory=dict)
+
+    # --- graph shape + capacity/padding occupancy ---
+    n_atoms: int = 0
+    num_partitions: int = 0
+    n_cap: int = 0                   # node capacity per partition
+    e_cap: int = 0                   # edge capacity per partition
+    b_cap: int = 0                   # bond-node capacity (0: no bond graph)
+    n_nodes_per_part: list[int] = field(default_factory=list)  # real rows
+    n_edges_per_part: list[int] = field(default_factory=list)
+    node_occupancy: float = 0.0      # max real nodes / n_cap over partitions
+    edge_occupancy: float = 0.0      # max real edges / e_cap over partitions
+
+    # --- halo volumes (rows exchanged per partition, summed over shifts) ---
+    halo_send_per_part: list[int] = field(default_factory=list)
+    halo_recv_per_part: list[int] = field(default_factory=list)
+    bond_halo_send_per_part: list[int] = field(default_factory=list)
+
+    # --- cache behavior ---
+    graph_reused: bool = False       # skin cache hit (positions-only scatter)
+    rebuild: bool = False            # this step built/adopted a new graph
+    prefetch_adopted: bool = False   # rebuild absorbed by the background build
+    compile_cache_size: int = 0      # jit executable cache entries after step
+    compiled: bool = False           # this step triggered an XLA compile
+
+    # --- device memory (bytes; empty where the backend reports nothing) ---
+    device_memory: dict[str, int] = field(default_factory=dict)
+
+    # --- free-form producer extras ---
+    extra: dict = field(default_factory=dict)
+
+    # ---- serialization ----
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StepRecord":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in known}
+        # unknown keys (a newer writer) ride along in extra, not lost
+        unknown = {k: v for k, v in d.items() if k not in known}
+        rec = cls(**kw)
+        if unknown:
+            rec.extra = {**rec.extra, **unknown}
+        return rec
+
+    @classmethod
+    def from_json(cls, line: str) -> "StepRecord":
+        return cls.from_dict(json.loads(line))
+
+    # ---- convenience ----
+    @property
+    def total_s(self) -> float:
+        t = self.timings.get("total_s")
+        if t is not None:
+            return float(t)
+        return float(sum(v for k, v in self.timings.items()
+                         if k != "total_s"))
+
+    def halo_imbalance(self) -> float:
+        """max/mean of per-partition halo send volume (1.0 = balanced)."""
+        v = self.halo_send_per_part
+        if not v:
+            return 1.0
+        mean = sum(v) / len(v)
+        return (max(v) / mean) if mean > 0 else 1.0
+
+
+# ---------------------------------------------------------------------------
+# shared phase-statistics helpers (one implementation for the live
+# AggregatingSink and the offline report — the two tables must not drift)
+# ---------------------------------------------------------------------------
+
+
+def percentile(sorted_xs: list[float], q: float) -> float:
+    """Nearest-rank percentile over an ALREADY SORTED sample list."""
+    if not sorted_xs:
+        return 0.0
+    n = len(sorted_xs)
+    return sorted_xs[min(n - 1, int(q * (n - 1) + 0.5))]
+
+
+def phase_stats_from_samples(xs: list[float], total_s: float | None = None,
+                             count: int | None = None) -> dict:
+    """total/count/mean/p50/p90/p99/max stats for one phase.
+
+    ``total_s``/``count`` override the sample-derived values when the
+    samples are a decimated subset of the real stream (AggregatingSink)."""
+    xs = sorted(xs)
+    if not xs:
+        return {"total_s": float(total_s or 0.0), "count": int(count or 0)}
+    total_s = float(sum(xs)) if total_s is None else float(total_s)
+    count = len(xs) if count is None else int(count)
+    return {
+        "total_s": total_s, "count": count,
+        "mean_s": total_s / max(count, 1),
+        "p50_s": percentile(xs, 0.50), "p90_s": percentile(xs, 0.90),
+        "p99_s": percentile(xs, 0.99), "max_s": xs[-1],
+    }
+
+
+def format_phase_table(phases: dict) -> str:
+    """Render {phase: stats} (as produced above) as the per-phase table,
+    ordered by total time descending."""
+    lines = [
+        "phase                    total_s   mean_ms    p50_ms    p90_ms"
+        "    p99_ms    max_ms  calls"
+    ]
+    order = sorted(phases, key=lambda k: phases[k].get("total_s", 0.0),
+                   reverse=True)
+    for k in order:
+        s = phases[k]
+        if "mean_s" not in s:
+            continue
+        lines.append(
+            f"{k:<24} {s['total_s']:8.3f} {1e3 * s['mean_s']:9.2f} "
+            f"{1e3 * s['p50_s']:9.2f} {1e3 * s['p90_s']:9.2f} "
+            f"{1e3 * s['p99_s']:9.2f} {1e3 * s['max_s']:9.2f} "
+            f"{s['count']:6d}")
+    return "\n".join(lines)

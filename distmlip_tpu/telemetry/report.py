@@ -1,0 +1,216 @@
+"""Aggregate a JSONL telemetry run into the per-phase report.
+
+Library half of ``tools/telemetry_report.py``: reads ``StepRecord`` JSONL,
+produces the per-phase total/mean/percentile table plus run-level counters,
+and flags the anomaly classes this repo has actually hit:
+
+- **stall** — a step whose total wall time exceeds ``stall_factor`` x the
+  run median (the round-5 wedged-chip signature: one step silently taking
+  25+ minutes while the driver saw nothing);
+- **occupancy collapse** — capacity/padding occupancy below
+  ``occupancy_floor``: the sticky capacity buckets grew far past the live
+  graph, so most of every padded array (and the FLOPs over it) is waste;
+- **halo imbalance** — max/mean per-partition halo send volume above
+  ``imbalance_factor``: one partition's communication dominates, the slab
+  decomposition needs rebalancing (arXiv:2504.10700's data-distribution
+  failure mode).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from .record import (StepRecord, format_phase_table, percentile,
+                     phase_stats_from_samples)
+
+
+def read_jsonl(path: str) -> list[StepRecord]:
+    """Parse a telemetry JSONL file; blank/corrupt lines are skipped (a
+    killed run may truncate its final line)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(StepRecord.from_json(line))
+            except (json.JSONDecodeError, TypeError):
+                continue
+    return records
+
+
+@dataclass
+class Anomaly:
+    kind: str       # stall | occupancy_collapse | halo_imbalance
+    step: int
+    detail: str
+
+
+@dataclass
+class Report:
+    n_records: int = 0
+    phases: dict = field(default_factory=dict)   # name -> stats dict
+    counters: dict = field(default_factory=dict)
+    anomalies: list = field(default_factory=list)
+
+    def table(self) -> str:
+        return format_phase_table(self.phases)
+
+    def render(self) -> str:
+        out = [self.table(), ""]
+        c = self.counters
+        out.append(
+            f"records={self.n_records} rebuilds={c.get('rebuilds', 0)} "
+            f"prefetch_adopted={c.get('prefetch_adopted', 0)} "
+            f"compiles={c.get('compiles', 0)} "
+            f"graph_reused={c.get('graph_reused', 0)}")
+        if "min_node_occupancy" in c:
+            out.append(
+                f"occupancy: node min={c['min_node_occupancy']:.2f} "
+                f"mean={c['mean_node_occupancy']:.2f}; "
+                f"edge min={c['min_edge_occupancy']:.2f} "
+                f"mean={c['mean_edge_occupancy']:.2f}")
+        if "max_halo_imbalance" in c:
+            out.append(f"halo send imbalance (max/mean over partitions): "
+                       f"worst={c['max_halo_imbalance']:.2f}")
+        if self.anomalies:
+            out.append("")
+            out.append(f"ANOMALIES ({len(self.anomalies)}):")
+            for a in self.anomalies:
+                out.append(f"  [{a.kind}] step {a.step}: {a.detail}")
+        else:
+            out.append("no anomalies flagged")
+        return "\n".join(out)
+
+    def to_dict(self) -> dict:
+        return {
+            "n_records": self.n_records,
+            "phases": self.phases,
+            "counters": self.counters,
+            "anomalies": [vars(a) for a in self.anomalies],
+        }
+
+
+def aggregate(
+    records: list[StepRecord],
+    stall_factor: float = 5.0,
+    occupancy_floor: float = 0.35,
+    imbalance_factor: float = 2.0,
+) -> Report:
+    rep = Report(n_records=len(records))
+    if not records:
+        return rep
+
+    # --- per-phase table ---
+    samples: dict[str, list[float]] = {}
+    for r in records:
+        for k, v in r.timings.items():
+            samples.setdefault(k, []).append(float(v))
+    for k, xs in samples.items():
+        rep.phases[k] = phase_stats_from_samples(xs)
+
+    # --- run counters ---
+    c = rep.counters
+    c["rebuilds"] = sum(r.rebuild for r in records)
+    c["prefetch_adopted"] = sum(r.prefetch_adopted for r in records)
+    c["compiles"] = sum(r.compiled for r in records)
+    c["graph_reused"] = sum(r.graph_reused for r in records)
+    node_occ = [r.node_occupancy for r in records if r.node_occupancy > 0]
+    edge_occ = [r.edge_occupancy for r in records if r.edge_occupancy > 0]
+    if node_occ and edge_occ:
+        c["min_node_occupancy"] = min(node_occ)
+        c["mean_node_occupancy"] = sum(node_occ) / len(node_occ)
+        c["min_edge_occupancy"] = min(edge_occ)
+        c["mean_edge_occupancy"] = sum(edge_occ) / len(edge_occ)
+    imb = [r.halo_imbalance() for r in records if r.halo_send_per_part]
+    if imb:
+        c["max_halo_imbalance"] = max(imb)
+
+    # --- anomalies ---
+    # stall detection is PER KIND: a DeviceMD chunk legitimately takes
+    # hundreds of calculate-steps' worth of wall time, so a mixed
+    # calculate/md_chunk run must not flag every chunk against the
+    # calculate median
+    by_kind: dict[str, list[StepRecord]] = {}
+    for r in records:
+        by_kind.setdefault(r.kind, []).append(r)
+    for kind, rs in by_kind.items():
+        totals = sorted(r.total_s for r in rs if r.total_s > 0)
+        med = percentile(totals, 0.50)
+        if med <= 0:
+            continue
+        for r in rs:
+            if r.total_s > stall_factor * med:
+                rep.anomalies.append(Anomaly(
+                    "stall", r.step,
+                    f"{kind} step took {r.total_s:.3f}s vs kind-median "
+                    f"{med:.3f}s (>{stall_factor:.0f}x) — wedge-style "
+                    f"stall or mid-run recompile"))
+    for r in records:
+        occs = [("node", r.node_occupancy), ("edge", r.edge_occupancy)]
+        low = [f"{what} {o:.2f}" for what, o in occs if 0 < o < occupancy_floor]
+        if low:
+            rep.anomalies.append(Anomaly(
+                "occupancy_collapse", r.step,
+                f"padding occupancy {', '.join(low)} below "
+                f"{occupancy_floor:.2f} — sticky capacities far above the "
+                f"live graph (mostly-padded compute)"))
+    for r in records:
+        if r.halo_send_per_part and r.halo_imbalance() > imbalance_factor:
+            rep.anomalies.append(Anomaly(
+                "halo_imbalance", r.step,
+                f"per-partition halo send max/mean = "
+                f"{r.halo_imbalance():.2f} (> {imbalance_factor:.1f}) — "
+                f"volumes {r.halo_send_per_part}"))
+    return rep
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m distmlip_tpu.telemetry.report run.jsonl [--json out]``.
+
+    Also exposed as ``tools/telemetry_report.py``.
+    """
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    opts = {"stall_factor": 5.0, "occupancy_floor": 0.35,
+            "imbalance_factor": 2.0}
+    out_json = None
+    try:
+        for flag in ("--stall-factor", "--occupancy-floor",
+                     "--imbalance-factor"):
+            while flag in argv:
+                i = argv.index(flag)
+                opts[flag[2:].replace("-", "_")] = float(argv[i + 1])
+                del argv[i:i + 2]
+        if "--json" in argv:
+            i = argv.index("--json")
+            out_json = argv[i + 1]
+            del argv[i:i + 2]
+    except (IndexError, ValueError):
+        print("usage: telemetry_report <run.jsonl> [--json out.json] "
+              "[--stall-factor F] [--occupancy-floor F] "
+              "[--imbalance-factor F]", file=sys.stderr)
+        return 2
+    if len(argv) != 1:
+        print("usage: telemetry_report <run.jsonl> [--json out.json] "
+              "[--stall-factor F] [--occupancy-floor F] "
+              "[--imbalance-factor F]", file=sys.stderr)
+        return 2
+    try:
+        records = read_jsonl(argv[0])
+    except OSError as e:
+        print(f"error: cannot read {argv[0]}: {e}", file=sys.stderr)
+        return 1
+    rep = aggregate(records, **opts)
+    print(rep.render())
+    if out_json:
+        with open(out_json, "w") as f:
+            json.dump(rep.to_dict(), f, indent=2, sort_keys=True)
+    return 0 if not rep.anomalies else 4
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,79 @@
+"""Trace annotations for the host hot path and jitted programs.
+
+Two kinds of annotation, matching how JAX profiling works:
+
+- ``annotate(name)`` — HOST-side ``jax.profiler.TraceAnnotation`` for the
+  phases that run in Python (neighbor build, partition/pad, device_put).
+  Gated on a module flag: disabled (the default) it returns a shared
+  null context manager — no jax import, no object construction beyond one
+  tuple lookup — so instrumented call sites add no measurable overhead.
+- ``scope(name)`` — ``jax.named_scope`` for code inside ``jit``. This only
+  attaches metadata to the traced HLO (op names in xprof timelines); it
+  costs nothing at runtime by construction, so it is always on.
+
+``device_trace(logdir)`` captures an xprof trace AND enables host
+annotations for its duration, so one context manager produces the fully
+named timeline the paper-style per-phase analysis needs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+_tracing = False
+
+
+def set_tracing(on: bool) -> None:
+    """Globally enable/disable host-side TraceAnnotations."""
+    global _tracing
+    _tracing = bool(on)
+
+
+def tracing_enabled() -> bool:
+    return _tracing
+
+
+class _NullContext:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullContext()
+
+
+def annotate(name: str):
+    """Host-side trace annotation; a shared no-op object when disabled."""
+    if not _tracing:
+        return _NULL
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def scope(name: str):
+    """Named scope for jitted code (trace-time metadata only)."""
+    import jax
+
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """jax.profiler trace context (view with tensorboard/xprof); host
+    annotations are enabled for the duration so the timeline names every
+    phase the runtime instruments."""
+    import jax
+
+    was = _tracing
+    set_tracing(True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        set_tracing(was)

@@ -43,6 +43,12 @@ PASS_DE = 1e-4   # eV/atom vs upstream
 PASS_DF = 1e-3   # eV/A max component vs upstream
 SELF_DE = 1e-5   # eV/atom P=2 vs P=1 (internal)
 
+# ONE task constant for both sides of the eSCN/UMA parity check: the local
+# eval's dataset-conditioning index and the upstream FAIRChemCalculator's
+# task_name must select the same csd/dataset embedding, or a multi-dataset
+# checkpoint reports a spurious FAIL (ADVICE r5).
+UMA_PARITY_TASK = "omat"
+
 
 def _log(stage, msg):
     print(f"[{stage}] {msg}", flush=True)
@@ -80,12 +86,19 @@ def make_fixture(cutoff: float, atomic_numbers, seed: int = 0):
 def _parse_value(v):
     """Parse a --set value: bool words, int, float, comma-tuple of ints,
     else the raw string. NEVER cast via type(existing) — bool('false') is
-    True and tuple('13,14') is character soup."""
+    True and tuple('13,14') is character soup. A malformed comma tuple
+    raises ValueError with a usable message; main() turns it into the
+    structured rc=2 usage error (never an uncaught traceback)."""
     low = v.lower()
     if low in ("true", "false"):
         return low == "true"
     if "," in v:
-        return tuple(int(x) for x in v.split(","))
+        try:
+            return tuple(int(x) for x in v.split(","))
+        except ValueError:
+            raise ValueError(
+                f"comma value {v!r} must be a tuple of ints (e.g. 2,2,1)"
+            ) from None
     for cast in (int, float):
         try:
             return cast(v)
@@ -348,7 +361,7 @@ def eval_upstream(family, ckpt, numbers, cart, lattice, info):
 
             atoms.info.update(info)
             atoms.calc = FAIRChemCalculator(load_predict_unit(ckpt),
-                                            task_name="omat")
+                                            task_name=UMA_PARITY_TASK)
         return float(atoms.get_potential_energy()), atoms.get_forces()
     except ImportError as e:
         _log("upstream", f"SKIP ({e})")
@@ -383,6 +396,16 @@ def main(argv=None):
         print(__doc__)
         print("ERROR: --set expects key=val and --out expects a path")
         return 2
+    # validate every --set value NOW, before any expensive export/infer
+    # work, so a malformed value (e.g. --set grid=2,2.5) is a structured
+    # usage error instead of an uncaught traceback mid-run
+    for k, v in overrides.items():
+        try:
+            _parse_value(v)
+        except ValueError as e:
+            print(__doc__)
+            print(f"ERROR: --set {k}={v}: {e}")
+            return 2
     if len(argv) != 2 or argv[0] not in _INFER:
         print(__doc__)
         return 2
@@ -415,8 +438,17 @@ def main(argv=None):
     _log("infer", f"{cfg}")
     _log_assumed(assumed, notes)
 
-    # 3-4. convert + our eval
-    info = {"charge": 0, "spin": 0, "dataset": 0} if family == "escn" else {}
+    # 3-4. convert + our eval (eSCN conditions on the SAME task as the
+    # upstream eval — see UMA_PARITY_TASK; a single-dataset checkpoint has
+    # only index 0, where the task routing is moot)
+    if family == "escn":
+        from ..calculators.calculator import UMA_TASK_DATASETS
+
+        ds = min(UMA_TASK_DATASETS[UMA_PARITY_TASK],
+                 getattr(cfg, "num_datasets", 1) - 1)
+        info = {"charge": 0, "spin": 0, "dataset": ds}
+    else:
+        info = {}
     numbers, cart, lattice = make_fixture(cfg.cutoff, zs)
     e_ours, f_ours = eval_ours(family, cfg, sd, numbers, cart, lattice, info)
 

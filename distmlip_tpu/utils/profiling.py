@@ -1,8 +1,13 @@
-"""Profiling / tracing utilities.
+"""Legacy profiling shims — superseded by ``distmlip_tpu.telemetry``.
 
-Reference analogues: C TIMING macros + torch.profiler ranges (SURVEY.md §5).
-Here: jax.profiler traces for device timelines plus a lightweight host-side
-step timer that aggregates the per-phase breakdown DistPotential records.
+.. deprecated::
+    ``StepTimer`` is subsumed by ``telemetry.AggregatingSink`` (same
+    aggregation, plus percentiles, occupancy, halo volumes, and the shared
+    ``StepRecord`` schema), and ``device_trace`` now lives in
+    ``telemetry.trace`` where it also enables host-side TraceAnnotations.
+    Both remain importable from here so existing scripts keep working; new
+    code should attach a ``telemetry.Telemetry`` hub to ``DistPotential``
+    instead of reading ``last_timings``.
 """
 
 from __future__ import annotations
@@ -11,21 +16,17 @@ import contextlib
 import time
 from collections import defaultdict
 
+from ..telemetry.trace import device_trace  # noqa: F401 - re-export
 
-@contextlib.contextmanager
-def device_trace(logdir: str):
-    """jax.profiler trace context; view with tensorboard or xprof."""
-    import jax
-
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+__all__ = ["StepTimer", "device_trace"]
 
 
 class StepTimer:
-    """Aggregates named phase timings across steps; prints a summary."""
+    """Aggregates named phase timings across steps; prints a summary.
+
+    .. deprecated:: use ``telemetry.AggregatingSink`` (accepts the same
+        ``add(timings)`` dict surface and full StepRecords).
+    """
 
     def __init__(self):
         self.totals: dict[str, float] = defaultdict(float)

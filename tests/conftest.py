@@ -9,12 +9,21 @@ Note: this image auto-registers the 'axon' TPU platform via sitecustomize
 and ignores JAX_PLATFORMS, so we force CPU through jax.config instead.
 """
 
+import os
+
+# must be set before the XLA CPU client initializes; the jax_num_cpu_devices
+# config option does not exist in this jax build (0.4.x), so the device
+# count goes through XLA_FLAGS instead
+_flag = "--xla_force_host_platform_device_count=8"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
 import jax
 import numpy as np
 import pytest
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
 
 
 @pytest.fixture(scope="session")

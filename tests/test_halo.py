@@ -12,10 +12,7 @@ from distmlip_tpu.parallel.halo import local_graph_from_stacked
 from distmlip_tpu.partition import build_plan, build_partitioned_graph
 from tests.conftest import random_cell
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:
-    from jax.experimental.shard_map import shard_map
+from distmlip_tpu.parallel.runtime import _NO_CHECK, shard_map
 
 R = 3.0
 
@@ -50,7 +47,7 @@ def test_halo_exchange_delivers_owner_rows(rng, nparts):
 
     out = shard_map(
         f, mesh=mesh, in_specs=(graph_in_specs(graph), P(GRAPH_AXIS)),
-        out_specs=P(GRAPH_AXIS), check_vma=False,
+        out_specs=P(GRAPH_AXIS), **_NO_CHECK,
     )(graph, jnp.asarray(local))
     out = np.asarray(out)
     for p in range(nparts):
@@ -74,7 +71,7 @@ def test_halo_exchange_gradients_flow_to_owner(rng, nparts):
     def total(feats):
         return shard_map(
             loss, mesh=mesh, in_specs=(graph_in_specs(graph), P(GRAPH_AXIS)),
-            out_specs=P(), check_vma=False,
+            out_specs=P(), **_NO_CHECK,
         )(graph, feats)
 
     local = jnp.asarray(host.scatter_global(np.zeros((n, 2), np.float32), graph.n_cap))
@@ -109,7 +106,7 @@ def test_bond_halo_exchange(rng, nparts):
     out = np.asarray(
         shard_map(
             f, mesh=mesh, in_specs=(graph_in_specs(graph), P(GRAPH_AXIS)),
-            out_specs=P(GRAPH_AXIS), check_vma=False,
+            out_specs=P(GRAPH_AXIS), **_NO_CHECK,
         )(graph, local)
     )
     for p in range(nparts):

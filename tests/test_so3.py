@@ -104,3 +104,40 @@ def test_cg_high_l(rng):
         C,
     )
     np.testing.assert_allclose(inv, C, atol=1e-8)
+
+
+@pytest.mark.parametrize("l_out,nu", [(0, 2), (1, 2), (0, 3), (1, 3)])
+def test_symmetric_coupling_basis(rng, l_out, nu):
+    """U must be equivariant, totally symmetric in its input slots, have
+    orthonormal path columns, and respect parity selection (live production
+    code: MACE's U-matrix contraction, models/mace.py)."""
+    a_ls = (0, 1, 2)
+    U = so3.symmetric_coupling_basis(a_ls, l_out, nu)
+    assert U is not None
+    S_A = 9
+    n = U.shape[-1]
+    # orthonormal path columns
+    flat = U.reshape(-1, n)
+    np.testing.assert_allclose(flat.T @ flat, np.eye(n), atol=1e-10)
+    # total symmetry in the nu input slots
+    perm = list(range(1, nu)) + [0, nu, nu + 1]
+    np.testing.assert_allclose(U, U.transpose(perm), atol=1e-10)
+    # equivariance: (D_sym x D_out) U = U for a random rotation
+    R = random_rotation(rng)
+    D = np.zeros((S_A, S_A))
+    o = 0
+    for l in a_ls:
+        D[o:o + 2 * l + 1, o:o + 2 * l + 1] = so3.wigner_d_from_rotation(l, R)
+        o += 2 * l + 1
+    out = U
+    for ax in range(nu):
+        out = np.tensordot(D, out, axes=([1], [ax]))
+        out = np.moveaxis(out, 0, ax)
+    out = np.einsum("...dn,pd->...pn", out,
+                    so3.wigner_d_from_rotation(l_out, R))
+    np.testing.assert_allclose(out, U, atol=1e-8)
+    # parity: entries with odd total l vanish
+    lvals = np.concatenate([[l] * (2 * l + 1) for l in a_ls])
+    idx = np.indices(U.shape[:nu])
+    tot_l = sum(lvals[idx[i]] for i in range(nu)) + l_out
+    assert np.abs(U[(tot_l % 2) == 1]).max() < 1e-10

@@ -1,0 +1,323 @@
+"""Telemetry subsystem: StepRecord round-trips, sinks receiving records from
+a real DistPotential step (CPU), report aggregation over a synthetic run,
+and the zero-overhead disabled path."""
+
+import json
+
+import numpy as np
+import pytest
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import Atoms, DistPotential
+from distmlip_tpu.calculators.device_md import DeviceMD
+from distmlip_tpu.models import PairConfig, PairPotential
+from distmlip_tpu.telemetry import (AggregatingSink, JsonlSink, StepRecord,
+                                    StderrSummarySink, Telemetry, annotate,
+                                    set_tracing, tracing_enabled)
+from distmlip_tpu.telemetry.report import aggregate, main as report_main, \
+    read_jsonl
+from distmlip_tpu.telemetry.trace import _NullContext
+
+
+def make_atoms(rng, reps=(3, 3, 3), a=3.8, noise=0.03):
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, noise, (len(frac), 3))
+    return Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
+
+
+def _pot(**kw):
+    model = PairPotential(PairConfig(cutoff=3.5, kind="lj"))
+    params = model.init()
+    params = {"eps": params["eps"] * 0.1, "sigma": params["sigma"]}
+    return DistPotential(model, params, compute_stress=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# StepRecord schema
+# ---------------------------------------------------------------------------
+
+
+def test_step_record_roundtrip():
+    rec = StepRecord(
+        step=7, kind="md_chunk",
+        timings={"neighbor_s": 0.01, "partition_s": 0.002, "device_s": 0.1,
+                 "total_s": 0.115},
+        n_atoms=108, num_partitions=2, n_cap=128, e_cap=2048,
+        n_nodes_per_part=[64, 60], n_edges_per_part=[1500, 1400],
+        node_occupancy=0.5, edge_occupancy=0.73,
+        halo_send_per_part=[12, 10], halo_recv_per_part=[10, 12],
+        graph_reused=True, compiled=True, compile_cache_size=3,
+        device_memory={"dev0_bytes_in_use": 1 << 20},
+        extra={"steps_done": 40},
+    )
+    back = StepRecord.from_json(rec.to_json())
+    assert back == rec
+    # JSONL line is a flat JSON object
+    d = json.loads(rec.to_json())
+    assert d["kind"] == "md_chunk" and d["extra"]["steps_done"] == 40
+
+
+def test_step_record_forward_compat():
+    """Unknown keys from a newer writer land in extra, not lost/crashing."""
+    d = StepRecord(step=1).to_dict()
+    d["future_field"] = 42
+    back = StepRecord.from_dict(d)
+    assert back.step == 1 and back.extra["future_field"] == 42
+
+
+def test_step_record_total_and_imbalance():
+    r = StepRecord(timings={"neighbor_s": 0.2, "device_s": 0.3})
+    assert r.total_s == pytest.approx(0.5)
+    r2 = StepRecord(halo_send_per_part=[30, 10])
+    assert r2.halo_imbalance() == pytest.approx(1.5)
+    assert StepRecord().halo_imbalance() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# sinks receiving records from a real CPU DistPotential step
+# ---------------------------------------------------------------------------
+
+
+def test_distpotential_emits_records(rng, tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    agg = AggregatingSink()
+    tel = Telemetry([agg, JsonlSink(path)])
+    pot = _pot(num_partitions=2, telemetry=tel)
+    atoms = make_atoms(rng)
+    for _ in range(3):
+        atoms.positions += rng.normal(0, 0.01, atoms.positions.shape)
+        pot.calculate(atoms)
+    tel.close()
+
+    assert agg.n_records == 3
+    assert agg.totals["device_s"] > 0
+    recs = read_jsonl(path)
+    assert len(recs) == 3
+    for r in recs:
+        assert r.kind == "calculate"
+        assert r.num_partitions == 2 and r.n_atoms == len(atoms)
+        # per-phase timings present
+        for k in ("neighbor_s", "partition_s", "device_s", "total_s"):
+            assert k in r.timings
+        # graph shape + padding occupancy
+        assert r.n_cap > 0 and 0 < r.node_occupancy <= 1.0
+        assert r.e_cap > 0 and 0 < r.edge_occupancy <= 1.0
+        assert len(r.n_nodes_per_part) == 2
+        # halo volumes per partition (P=2 slabs exchange both directions)
+        assert len(r.halo_send_per_part) == 2
+        assert all(v > 0 for v in r.halo_send_per_part)
+        # every sent row is received somewhere
+        assert sum(r.halo_recv_per_part) == sum(r.halo_send_per_part)
+        # skin=0: every step rebuilds
+        assert r.rebuild and not r.graph_reused
+    # first step compiled the potential, later steps hit the executable cache
+    assert recs[0].compiled
+    assert recs[0].compile_cache_size >= 1
+    assert not recs[-1].compiled
+    # summary renders the phase table
+    s = agg.summary()
+    assert "device_s" in s and "records=3" in s
+
+
+def test_skin_cache_hits_recorded(rng):
+    agg = AggregatingSink()
+    pot = _pot(num_partitions=1, skin=1.0, async_rebuild=False,
+               telemetry=Telemetry([agg]))
+    atoms = make_atoms(rng)
+    pot.calculate(atoms)
+    atoms.positions += 1e-4  # far inside the Verlet budget
+    pot.calculate(atoms)
+    assert agg.rebuilds == 1
+    assert agg.n_records == 2
+
+
+def test_device_md_chunk_records(rng):
+    agg = AggregatingSink()
+    pot = _pot(num_partitions=1, skin=1.0, async_rebuild=False)
+    atoms = make_atoms(rng)
+    atoms.set_maxwell_boltzmann_velocities(50.0, rng=rng)
+    md = DeviceMD(pot, atoms, timestep=0.5, telemetry=Telemetry([agg]))
+    md.run(10)
+    assert agg.n_records >= 1
+    assert agg.totals["device_s"] > 0
+    assert agg.samples["total_s"]  # chunk wall time recorded
+
+
+def test_aggregating_sink_bounded_memory():
+    """Sample buffers decimate past max_samples; totals/means stay exact."""
+    agg = AggregatingSink(max_samples=64)
+    n = 1000
+    for i in range(n):
+        agg.emit(StepRecord(timings={"device_s": float(i)}))
+    assert len(agg.samples["device_s"]) < 64
+    s = agg.phase_stats("device_s")
+    assert s["count"] == n
+    assert s["total_s"] == pytest.approx(sum(range(n)))
+    assert s["mean_s"] == pytest.approx(sum(range(n)) / n)
+    # decimated percentiles still track the distribution
+    assert abs(s["p50_s"] - n / 2) < n * 0.05
+    # no halo data -> no imbalance stat claimed (matches report.py)
+    assert agg.max_halo_imbalance == 0.0
+    assert "max_halo_imbalance" not in agg.summary()
+
+
+def test_emit_after_close_is_noop(tmp_path):
+    path = str(tmp_path / "x.jsonl")
+    tel = Telemetry([JsonlSink(path), AggregatingSink()])
+    tel.emit(StepRecord(step=0, timings={"total_s": 0.1}))
+    tel.close()
+    tel.emit(StepRecord(step=1, timings={"total_s": 0.1}))  # must not raise
+    assert len(read_jsonl(path)) == 1
+
+
+def test_stderr_summary_sink(capsys):
+    sink = StderrSummarySink(every=2)
+    tel = Telemetry([sink])
+    for i in range(3):
+        tel.emit(StepRecord(step=i, timings={"device_s": 0.01},
+                            node_occupancy=0.8, rebuild=(i == 0)))
+    tel.close()
+    err = capsys.readouterr().err
+    # one periodic line (step 1) + one close line (step 2)
+    assert err.count("# telemetry") == 2
+    assert "node_occ=0.80" in err
+
+
+# ---------------------------------------------------------------------------
+# report aggregation
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_run(path, n=20):
+    with open(path, "w") as f:
+        for i in range(n):
+            rec = StepRecord(
+                step=i, timings={"neighbor_s": 0.01, "device_s": 0.10,
+                                 "total_s": 0.11},
+                n_atoms=256, num_partitions=4, n_cap=128, e_cap=1024,
+                node_occupancy=0.8, edge_occupancy=0.75,
+                halo_send_per_part=[10, 11, 10, 9],
+                rebuild=(i % 5 == 0), graph_reused=(i % 5 != 0))
+            f.write(rec.to_json() + "\n")
+        # wedge-style stall
+        f.write(StepRecord(step=n, timings={"device_s": 5.0, "total_s": 5.0},
+                           node_occupancy=0.8, edge_occupancy=0.7,
+                           ).to_json() + "\n")
+        # occupancy collapse + halo imbalance
+        f.write(StepRecord(step=n + 1,
+                           timings={"device_s": 0.1, "total_s": 0.11},
+                           node_occupancy=0.1, edge_occupancy=0.08,
+                           halo_send_per_part=[100, 5, 5, 5],
+                           ).to_json() + "\n")
+
+
+def test_report_aggregates_and_flags(tmp_path):
+    path = str(tmp_path / "synthetic.jsonl")
+    _synthetic_run(path)
+    recs = read_jsonl(path)
+    rep = aggregate(recs)
+    assert rep.n_records == 22
+    assert rep.phases["device_s"]["count"] == 22
+    assert rep.phases["device_s"]["max_s"] == pytest.approx(5.0)
+    assert rep.phases["neighbor_s"]["p50_s"] == pytest.approx(0.01)
+    kinds = {a.kind for a in rep.anomalies}
+    assert kinds == {"stall", "occupancy_collapse", "halo_imbalance"}
+    txt = rep.render()
+    assert "ANOMALIES" in txt and "device_s" in txt
+    # per-phase table has the percentile columns
+    assert "p99_ms" in rep.table()
+
+
+def test_stall_detection_is_per_kind():
+    """A DeviceMD chunk legitimately spans many calculate-steps of wall
+    time; it must not be flagged as a stall against the calculate median."""
+    recs = [StepRecord(step=i, kind="calculate",
+                       timings={"total_s": 0.1}) for i in range(10)]
+    recs += [StepRecord(step=10 + i, kind="md_chunk",
+                        timings={"total_s": 5.0}) for i in range(4)]
+    rep = aggregate(recs)
+    assert not [a for a in rep.anomalies if a.kind == "stall"]
+    # a genuine stall WITHIN a kind still flags
+    recs.append(StepRecord(step=99, kind="md_chunk",
+                           timings={"total_s": 100.0}))
+    rep = aggregate(recs)
+    stalls = [a for a in rep.anomalies if a.kind == "stall"]
+    assert len(stalls) == 1 and stalls[0].step == 99
+
+
+def test_report_cli(tmp_path, capsys):
+    path = str(tmp_path / "synthetic.jsonl")
+    _synthetic_run(path)
+    out_json = str(tmp_path / "report.json")
+    rc = report_main([path, "--json", out_json])
+    assert rc == 4  # anomalies flagged
+    out = capsys.readouterr().out
+    assert "phase" in out and "ANOMALIES" in out
+    rep = json.loads(open(out_json).read())
+    assert rep["n_records"] == 22 and rep["anomalies"]
+    # clean run exits 0
+    clean = str(tmp_path / "clean.jsonl")
+    with open(clean, "w") as f:
+        for i in range(5):
+            f.write(StepRecord(step=i, timings={"device_s": 0.1,
+                                                "total_s": 0.1},
+                               node_occupancy=0.9,
+                               edge_occupancy=0.9).to_json() + "\n")
+    assert report_main([clean]) == 0
+    assert report_main([]) == 2  # usage
+
+
+def test_report_skips_corrupt_lines(tmp_path):
+    path = str(tmp_path / "trunc.jsonl")
+    with open(path, "w") as f:
+        f.write(StepRecord(step=0, timings={"total_s": 0.1}).to_json() + "\n")
+        f.write('{"step": 1, "timings"')  # killed mid-write
+    assert len(read_jsonl(path)) == 1
+
+
+# ---------------------------------------------------------------------------
+# disabled path: zero overhead
+# ---------------------------------------------------------------------------
+
+
+def test_annotate_noop_when_disabled():
+    assert not tracing_enabled()
+    cm = annotate("distmlip/neighbor_build")
+    assert isinstance(cm, _NullContext)
+    # the SAME shared object every call — no per-call allocation
+    assert annotate("other") is cm
+    with cm:
+        pass
+    set_tracing(True)
+    try:
+        assert not isinstance(annotate("x"), _NullContext)
+    finally:
+        set_tracing(False)
+
+
+def test_no_records_without_telemetry(rng, monkeypatch):
+    """With telemetry unset, calculate() never constructs a StepRecord."""
+    import distmlip_tpu.calculators.calculator as calc_mod
+
+    def boom(*a, **kw):
+        raise AssertionError("StepRecord constructed on the disabled path")
+
+    monkeypatch.setattr(calc_mod, "StepRecord", boom)
+    pot = _pot(num_partitions=1)
+    res = pot.calculate(make_atoms(rng))
+    assert np.isfinite(res["energy"])
+    # last_timings backward-compat surface still populated
+    assert pot.last_timings["device_s"] > 0
+
+
+def test_disabled_hub_not_invoked(rng):
+    class Exploding(AggregatingSink):
+        def emit(self, record):
+            raise AssertionError("sink invoked while disabled")
+
+    tel = Telemetry([Exploding()], enabled=False)
+    pot = _pot(num_partitions=1, telemetry=tel)
+    res = pot.calculate(make_atoms(rng))
+    assert np.isfinite(res["energy"])

@@ -345,10 +345,12 @@ def _main_measured():
     jsonl_path = os.environ.get("BENCH_TELEMETRY_JSONL")
     if jsonl_path:
         telemetry.add_sink(JsonlSink(jsonl_path))
+    halo_mode = os.environ.get("BENCH_HALO_MODE", "coalesced")
     pot = DistPotential(model, params, num_partitions=len(jax.devices()),
                         compute_stress=True,
                         skin=float(os.environ.get("BENCH_SKIN", "0.5")),
-                        compute_dtype=bench_dtype, telemetry=telemetry)
+                        compute_dtype=bench_dtype, halo_mode=halo_mode,
+                        telemetry=telemetry)
     watchdog.n_atoms = len(atoms)
     watchdog.n_devices = len(jax.devices())
 
@@ -376,8 +378,40 @@ def _main_measured():
     dt = float(np.median(watchdog.times))
     atoms_per_sec = len(atoms) / dt / max(len(jax.devices()), 1)
 
+    # overlap-pipeline accounting: collective count of the measured mode AND
+    # its A/B counterpart (host-side jaxpr traces — no device work), plus
+    # the analytic-FLOP mfu for the measured steps
+    extras = {"halo_mode": halo_mode}
+    try:
+        from distmlip_tpu.parallel import make_potential_fn
+        from distmlip_tpu.parallel.audit import count_collectives
+
+        graph = pot._cache[0] if pot._cache else None
+        if graph is not None:
+            for mode in ("coalesced", "legacy"):
+                p_mode = make_potential_fn(
+                    model.energy_fn, pot.mesh, halo_mode=mode)
+                jaxpr = jax.make_jaxpr(p_mode)(pot.params, graph,
+                                               graph.positions)
+                extras[f"collectives_{mode}"] = sum(
+                    count_collectives(jaxpr).values())
+    except Exception as e:  # noqa: BLE001 - accounting must not fail the run
+        extras["collectives_error"] = str(e)[:120]
+    try:
+        from distmlip_tpu.utils.flops import mfu as _mfu
+        from distmlip_tpu.utils.flops import model_flop_estimate
+
+        stats = (pot._cache[1].stats or {}) if pot._cache else {}
+        flops = model_flop_estimate(
+            model, len(atoms), sum(stats.get("n_edges_per_part", [])))
+        extras["mfu"] = round(
+            _mfu(flops, dt, max(len(jax.devices()), 1)), 4)
+        extras["flops_per_step"] = float(f"{flops:.3e}")
+    except Exception as e:  # noqa: BLE001
+        extras["mfu_error"] = str(e)[:120]
+
     print(_result_json(atoms_per_sec, _vs_baseline(atoms_per_sec),
-                       dtype=bench_dtype, a_lmax=cfg.a_lmax))
+                       dtype=bench_dtype, a_lmax=cfg.a_lmax, **extras))
     # the structured per-phase breakdown replaces the old hand-formatted
     # pot.last_timings line; the same records went to the JSONL sink when
     # BENCH_TELEMETRY_JSONL is set (render with tools/telemetry_report.py)

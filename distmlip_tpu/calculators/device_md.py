@@ -136,7 +136,8 @@ class DeviceMD:
         self.temperature = temperature
         self.taut = float(taut) if temperature is not None else 0.0
         self._total_energy = make_total_energy(
-            potential.model.energy_fn, potential.mesh
+            potential.model.energy_fn, potential.mesh,
+            halo_mode=getattr(potential, "halo_mode", "coalesced"),
         )
         self._stepper = _make_chunk_stepper(
             self._total_energy, self.dt, potential.skin

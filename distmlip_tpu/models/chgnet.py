@@ -24,11 +24,16 @@ modules (reference chgnet.py:116-197, 231-453 and chgnet_layers.py:16-119):
   - sitewise readout (magmoms) runs BEFORE the final atom conv; the final
     MLP readout after it (reference chgnet.py:391-440)
 
-Distributed flow per layer (atom conv -> edge_to_bond -> bond+atom halo
-exchange -> line-graph node conv -> bond_to_edge -> bond halo -> angle
-phase) matches reference chgnet.py:296-368; the node/edge conv split of
-reference chgnet_layers.py:16-119 falls out naturally here because the
-line graph only draws in-lines to locally-computed bond nodes.
+Distributed flow per layer (atom conv -> edge_to_bond -> ONE coalesced
+atom+bond halo exchange -> line-graph node conv -> bond_to_edge -> bond
+halo -> angle phase) matches reference chgnet.py:296-368; the node/edge
+conv split of reference chgnet_layers.py:16-119 falls out naturally here
+because the line graph only draws in-lines to locally-computed bond
+nodes. The atom conv runs through the interior/frontier split
+(LocalGraph.overlapped_edge_sum): interior-edge messages read the
+pre-exchange features so XLA can overlap them with the in-flight
+ppermute, and the sitewise readout rides the energy forward via
+``energy_and_aux_fn`` instead of a second full pass.
 
 Geometry for halo bond nodes (their endpoints may not be present locally)
 arrives by bond-halo exchange of (vec, dist), matching the reference's
@@ -152,8 +157,23 @@ class CHGNet:
         e_ref = params["species_ref"]["w"][lg.species, 0]
         return params["data_std"] * e_atom + e_ref
 
+    def energy_and_aux_fn(self, params, lg, positions):
+        """Fused readout: per-atom energies plus the sitewise outputs
+        (magmoms) from the SAME forward pass — the runtime's ``aux=True``
+        contract. Replaces make_site_fn's separate full forward for
+        magmom-every-step MD (the parity oracle lives in
+        tests/test_halo_overlap.py)."""
+        v, site = self._trunk(params, lg, positions)
+        e_atom = mlp(params["final"], v)[:, 0]
+        e_ref = params["species_ref"]["w"][lg.species, 0]
+        energy = params["data_std"] * e_atom + e_ref
+        return energy, {"magmoms": jnp.abs(site[:, 0])}
+
     def magmom_fn(self, params, lg, positions):
-        """Site-wise magnetic moments (absolute value), CHGNet's charge proxy."""
+        """Site-wise magnetic moments (absolute value), CHGNet's charge proxy.
+
+        Standalone readout (runs its own forward) — prefer the fused
+        ``energy_and_aux_fn`` when energies are being computed anyway."""
         _, site = self._trunk(params, lg, positions)
         return jnp.abs(site[:, 0])
 
@@ -199,9 +219,12 @@ class CHGNet:
                * in_r[:, None]).astype(dtype)
 
         # --- feature init ---
+        # v: pre-exchange view (owned rows authoritative); vx: post-exchange
+        # view. Interior edges (both endpoints owned) read v so their
+        # compute is data-independent of the in-flight ppermute producing
+        # vx — the interior/frontier overlap scheduling (parallel/halo.py).
         v = embedding(params["atom_emb"], lg.species)     # (N, C)
         e = mlp(params["bond_emb"], rbf)                  # (E, C)
-        v = lg.halo_exchange(v)
 
         # shared rbf message weights (reference chgnet.py:267-294)
         abw = linear(params["atom_bond_w"], rbf) if "atom_bond_w" in params else None
@@ -210,11 +233,13 @@ class CHGNet:
         use_bg = cfg.use_bond_graph and lg.has_bond_graph and params["bond_blocks"]
         if use_bg:
             # bond-node geometry: seed owned from edges, exchange halo rows
-            # (reference bond_transfer of bond_dist/bond_vec, chgnet.py:126-164)
+            # (reference bond_transfer of bond_dist/bond_vec, chgnet.py:
+            # 126-164) — COALESCED with the atom-feature init exchange: both
+            # refreshes ride one ppermute per ring shift
             bgeo = jnp.zeros((lg.b_cap, 4), dtype=positions.dtype)
             edge_geo = jnp.concatenate([vec, d[:, None]], axis=-1)
             bgeo = lg.edge_to_bond(edge_geo, bgeo)
-            bgeo = lg.bond_halo_exchange(bgeo)
+            (vx,), (bgeo,) = lg.exchange_all((v,), (bgeo,))
             b_vec, b_d = bgeo[:, :3], bgeo[:, 3]
             # padded bond rows have d=0; skin-shell bonds (d > bond_cutoff)
             # are excluded like skin-shell edges above
@@ -247,48 +272,67 @@ class CHGNet:
             # top of every block (reference dist_forward re-seeds the same
             # way, :253-264, :315-321), so no separate init pass is needed
             b = jnp.zeros((lg.b_cap, C), dtype=e.dtype)
+        else:
+            vx = lg.halo_exchange(v)
 
         # --- message-passing blocks (reference chgnet.py:296-389) ---
         for i in range(cfg.num_blocks - 1):
-            v, e = self._atom_conv(params["atom_blocks"][i], lg, v, e, abw,
-                                   bbw, in_r)
-            v = lg.halo_exchange(v)
+            v, e = self._atom_conv(params["atom_blocks"][i], lg, v, vx, e,
+                                   abw, bbw, in_r)
             if use_bg:
                 b = lg.edge_to_bond(e, b)
-                b = lg.bond_halo_exchange(b)
+                # atom + bond refresh at one sync point -> one collective
+                (vx,), (b,) = lg.exchange_all((v,), (b,))
                 blk = params["bond_blocks"][i]
-                b = self._bond_node_conv(blk, lg, v, b, a, tbw, line_ok)
+                b = self._bond_node_conv(blk, lg, vx, b, a, tbw, line_ok)
                 e = lg.bond_to_edge(b, e)
-                b = lg.bond_halo_exchange(b)
-                a = self._angle_conv(blk, lg, v, b, a, line_ok)
+                _, (b,) = lg.exchange_all((), (b,))
+                a = self._angle_conv(blk, lg, vx, b, a, line_ok)
+            else:
+                vx = lg.halo_exchange(v)
 
-        # sitewise readout BEFORE the last atom conv (reference :391-398)
-        site = linear(fp["sitewise"], v.astype(positions.dtype))
+        # sitewise readout BEFORE the last atom conv (reference :391-398);
+        # owned rows of v and vx are identical — vx keeps halo-row parity
+        # with the historical post-exchange readout
+        site = linear(fp["sitewise"], vx.astype(positions.dtype))
 
-        # final atom conv (reference :400-419)
-        v, e = self._atom_conv(params["atom_blocks"][-1], lg, v, e, abw, bbw,
-                               in_r)
-        v = lg.halo_exchange(v)
+        # final atom conv (reference :400-419). No trailing halo exchange:
+        # the energy/site readouts only consume owned rows (owned_sum /
+        # gather_owned mask the rest), so refreshing halo rows after the
+        # last conv was dead communication.
+        v, e = self._atom_conv(params["atom_blocks"][-1], lg, v, vx, e, abw,
+                               bbw, in_r)
         return v.astype(positions.dtype), site
 
     # ---- layers ----
-    def _atom_conv(self, blk, lg, v, e, abw, bbw, in_r):
+    def _atom_conv(self, blk, lg, v, vx, e, abw, bbw, in_r):
         """matgl CHGNetGraphConv: optional gated edge update, then gated node
         messages weighted per edge, summed to dst (owner-computes), bias-free
-        out linear, residual. ``in_r`` masks padded AND skin-shell edges."""
+        out linear, residual. ``in_r`` masks padded AND skin-shell edges.
+
+        ``v`` is the pre-exchange view, ``vx = exchange(v)`` — the node
+        phase runs through ``lg.overlapped_edge_sum`` so interior-edge
+        GEMMs don't wait on the ppermute producing ``vx``. Returns the new
+        pre-exchange ``v`` (halo rows carry the residual base's stale
+        values; every consumer re-exchanges first)."""
         if "edge_update" in blk:
-            feats = jnp.concatenate([v[lg.edge_src], v[lg.edge_dst], e], axis=-1)
+            # per-edge output (no dst aggregation): full edge list on the
+            # post-exchange view, no overlap structure
+            feats = jnp.concatenate([vx[lg.edge_src], vx[lg.edge_dst], e],
+                                    axis=-1)
             m = linear(blk["edge_out"], gated_mlp(blk["edge_update"], feats))
             if bbw is not None:
                 m = m * bbw
             e = e + m * in_r[:, None].astype(m.dtype)
-        feats = jnp.concatenate([v[lg.edge_src], v[lg.edge_dst], e], axis=-1)
-        m = gated_mlp(blk["node_update"], feats)
-        if abw is not None:
-            m = m * abw
-        agg = masked_segment_sum(m, lg.edge_dst, lg.n_cap, in_r,
-                                 indices_are_sorted=True)
-        v = v + linear(blk["node_out"], agg)
+
+        def node_msg(vs, vd, e_sl, *w_sl):
+            m = gated_mlp(blk["node_update"],
+                          jnp.concatenate([vs, vd, e_sl], axis=-1))
+            return m * w_sl[0] if w_sl else m
+
+        edge_data = (e,) if abw is None else (e, abw)
+        agg = lg.overlapped_edge_sum(node_msg, v, vx, edge_data, mask=in_r)
+        v = vx + linear(blk["node_out"], agg)
         return v, e
 
     def _bond_node_conv(self, blk, lg, v, b, a, tbw, line_ok):

@@ -210,18 +210,23 @@ class ESCN:
         # + 2 constant matmuls per l — noise next to the SO(2) GEMMs), so
         # peak memory is O(chunk), not O(E). Scaffolding shared with MACE
         # (ops/chunk.py).
-        from ..ops.chunk import (chunk_spec, chunked, pad_index, pad_rows,
-                                 scan_accumulate)
+        from ..ops.chunk import chunk_layout, chunked, scan_accumulate
 
         e_cap = lg.edge_src.shape[0]
-        K, chunk, pad = chunk_spec(e_cap, cfg.edge_chunk)
+        # chunk boundaries aligned to the interior/frontier split so every
+        # chunk's dst stays sorted (indices_are_sorted survives the layout)
+        row_idx, row_valid, K, chunk = chunk_layout(
+            e_cap, cfg.edge_chunk,
+            lg.e_split if lg.has_frontier_split else None)
+        take = lambda x: chunked(jnp.asarray(x)[row_idx], K, chunk)
         edge_xs = (
-            chunked(pad_index(lg.edge_src, pad), K, chunk),
-            chunked(pad_index(lg.edge_dst, pad), K, chunk),
-            chunked(pad_rows(lg.edge_mask, pad), K, chunk),
-            chunked(pad_rows(rhat, pad), K, chunk),
-            chunked(pad_rows(bessel, pad), K, chunk),
-            chunked(pad_rows(env, pad), K, chunk),
+            take(lg.edge_src),
+            take(lg.edge_dst),
+            chunked(jnp.asarray(lg.edge_mask)[row_idx]
+                    & jnp.asarray(row_valid), K, chunk),
+            take(rhat),
+            take(bessel),
+            take(env),
         )
         # single-chunk path: build D once (fp32) and share it across the
         # edge-degree pass and every layer instead of per edge_scan call
@@ -247,7 +252,8 @@ class ESCN:
                 return (
                     acc
                     + masked_segment_sum(
-                        msg, dstc, lg.n_cap, maskc, indices_are_sorted=True
+                        # sorted within every chunk by chunk_layout
+                        msg, dstc, lg.n_cap, maskc, indices_are_sorted=True,
                     ),
                     None,
                 )

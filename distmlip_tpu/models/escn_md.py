@@ -371,19 +371,23 @@ class ESCNMD:
         else:
             mole = None
 
-        # --- edge-chunked scan scaffolding (shared with models/escn.py) ---
-        from ..ops.chunk import (chunk_spec, chunked, pad_index, pad_rows,
-                                 scan_accumulate)
+        # --- edge-chunked scan scaffolding (shared with models/escn.py);
+        # chunk_layout keeps every chunk inside one dst-sorted edge segment
+        from ..ops.chunk import chunk_layout, chunked, scan_accumulate
 
         e_cap = lg.edge_src.shape[0]
-        K_ch, chunk, pad = chunk_spec(e_cap, cfg.edge_chunk)
+        row_idx, row_valid, K_ch, chunk = chunk_layout(
+            e_cap, cfg.edge_chunk,
+            lg.e_split if lg.has_frontier_split else None)
+        take = lambda x: chunked(jnp.asarray(x)[row_idx], K_ch, chunk)
         edge_xs = (
-            chunked(pad_index(lg.edge_src, pad), K_ch, chunk),
-            chunked(pad_index(lg.edge_dst, pad), K_ch, chunk),
-            chunked(pad_rows(lg.edge_mask, pad), K_ch, chunk),
-            chunked(pad_rows(rhat, pad), K_ch, chunk),
-            chunked(pad_rows(gauss, pad), K_ch, chunk),
-            chunked(pad_rows(env, pad), K_ch, chunk),
+            take(lg.edge_src),
+            take(lg.edge_dst),
+            chunked(jnp.asarray(lg.edge_mask)[row_idx]
+                    & jnp.asarray(row_valid), K_ch, chunk),
+            take(rhat),
+            take(gauss),
+            take(env),
         )
 
         # per-l lab-from-edge blocks; ops/so3_e3nn builds them at >= fp32
@@ -419,7 +423,9 @@ class ESCNMD:
                 msg = per_chunk(srcc, dstc, maskc, D, gaussc, envc)
                 return (
                     acc + masked_segment_sum(
-                        msg, dstc, lg.n_cap, maskc, indices_are_sorted=True),
+                        # sorted within every chunk by chunk_layout
+                        msg, dstc, lg.n_cap, maskc,
+                        indices_are_sorted=True),
                     None,
                 )
 

@@ -400,10 +400,9 @@ class MACE:
             p=cfg.cutoff_p,
         )
         e_edge = jnp.where(lg.edge_mask, e_edge, 0.0)
-        return 0.5 * masked_segment_sum(
-            e_edge[:, None], lg.edge_dst, lg.species.shape[0],
-            indices_are_sorted=True,
-        )[:, 0]
+        # aggregate_edges: per-segment sorted sums under the
+        # interior/frontier edge layout
+        return 0.5 * lg.aggregate_edges(e_edge[:, None])[:, 0]
 
     def _interaction(self, inter, h, *, lg, Y, bessel, z, t):
         """One MACE interaction: density projection + symmetric contraction +
@@ -445,17 +444,23 @@ class MACE:
 
         # density projection A, accumulated over edge chunks (memory-bounded):
         # per chunk, outer(h_src, Y) -> one GEMM over every CG path -> radial
-        # weight -> ONE sorted segment sum carrying all Q path components
-        from ..ops.chunk import (chunk_spec, chunked, pad_index, pad_rows,
-                                 scan_accumulate)
+        # weight -> ONE sorted segment sum carrying all Q path components.
+        # chunk_layout aligns chunk boundaries to the interior/frontier
+        # split so every chunk's dst stays sorted (fast-path hint holds)
+        from ..ops.chunk import chunk_layout, chunked, scan_accumulate
 
         e_cap = lg.edge_src.shape[0]
-        K, chunk, pad = chunk_spec(e_cap, cfg.edge_chunk)
-        src_ch = chunked(pad_index(lg.edge_src, pad), K, chunk)
-        dst_ch = chunked(pad_index(lg.edge_dst, pad), K, chunk)
-        mask_ch = chunked(pad_rows(lg.edge_mask, pad), K, chunk)
-        bes_ch = chunked(pad_rows(bessel, pad), K, chunk)
-        Y_ch = chunked(pad_rows(Y_full, pad), K, chunk)
+        row_idx, row_valid, K, chunk = chunk_layout(
+            e_cap, cfg.edge_chunk,
+            lg.e_split if lg.has_frontier_split else None)
+        take = lambda x: chunked(jnp.asarray(x)[row_idx], K, chunk)
+        src_ch = take(lg.edge_src)
+        dst_ch = take(lg.edge_dst)
+        mask_ch = chunked(
+            jnp.asarray(lg.edge_mask)[row_idx] & jnp.asarray(row_valid),
+            K, chunk)
+        bes_ch = take(bessel)
+        Y_ch = take(Y_full)
 
         Wp3 = Wp.reshape(proj["S_h"], proj["S_Y"], nQ)
 
@@ -473,7 +478,8 @@ class MACE:
             return (
                 A_acc
                 + masked_segment_sum(
-                    M, dstc, n_nodes, maskc, indices_are_sorted=True
+                    # sorted within every chunk by chunk_layout construction
+                    M, dstc, n_nodes, maskc, indices_are_sorted=True,
                 ),
                 None,
             )

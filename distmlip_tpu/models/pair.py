@@ -15,7 +15,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import radial
-from ..ops.segment import masked_segment_sum
 
 # Covalent radii in Å (Cordero et al. 2008), indexed by atomic number Z;
 # index 0 unused. Used for the per-pair ZBL cutoff r_max = r_cov(Zu)+r_cov(Zv).
@@ -86,6 +85,6 @@ class PairPotential:
             ex = jnp.exp(-params["a"] * (d - params["r0"]))
             e_edge = params["D"] * (ex * ex - 2.0 * ex)
         e_edge = jnp.where(lg.edge_mask, e_edge * env, 0.0)
-        # half: every pair appears as two directed edges
-        return 0.5 * masked_segment_sum(e_edge[:, None], lg.edge_dst, lg.n_cap,
-                                        indices_are_sorted=True)[:, 0]
+        # half: every pair appears as two directed edges; aggregate_edges
+        # honors the interior/frontier edge layout (per-segment sorted)
+        return 0.5 * lg.aggregate_edges(e_edge[:, None])[:, 0]

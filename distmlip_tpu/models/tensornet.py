@@ -32,7 +32,6 @@ import jax.numpy as jnp
 from ..ops import radial
 from ..ops.nn import (cast_params_subtrees, embedding, layernorm,
                       layernorm_init, linear, linear_init, mlp, mlp_init)
-from ..ops.segment import masked_segment_sum
 
 
 @dataclass(frozen=True)
@@ -163,8 +162,7 @@ class TensorNet:
             + W2[:, None, None, :] * A_e
             + W3[:, None, None, :] * S_e
         )                                                        # (E, 3, 3, C)
-        X = masked_segment_sum(edge_X, lg.edge_dst, lg.n_cap, lg.edge_mask,
-                               indices_are_sorted=True)
+        X = lg.aggregate_edges(edge_X, lg.edge_mask)
 
         norm = layernorm(params["init_norm"], tensor_norm(X))
         for lin in params["emb_lin_scalar"]:
@@ -213,8 +211,7 @@ class TensorNet:
         msg = (f[:, None, None, :, 0] * I[lg.edge_src]
                + f[:, None, None, :, 1] * A[lg.edge_src]
                + f[:, None, None, :, 2] * S[lg.edge_src])
-        M = masked_segment_sum(msg, lg.edge_dst, lg.n_cap, lg.edge_mask,
-                               indices_are_sorted=True)
+        M = lg.aggregate_edges(msg, lg.edge_mask)
 
         # batched 3x3 matmuls over (node, channel); the matrix axes are
         # (-3, -2), channels ride the lane axis untouched

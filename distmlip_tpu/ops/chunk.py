@@ -12,6 +12,47 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def chunk_layout(e_cap: int, chunk: int, e_split: int | None = None):
+    """Row-gather chunk layout for edge scans, aligned to the
+    interior/frontier boundary.
+
+    Returns ``(row_index, row_valid, K, chunk)``: build each scan input as
+    ``chunked(x[row_index], K, chunk)`` and AND ``row_valid`` into the edge
+    mask. With an active split (``0 <= e_split < e_cap``) the two segments
+    are padded to chunk multiples INDEPENDENTLY, so no chunk ever straddles
+    the boundary — every chunk's dst rows stay nondecreasing and the
+    ``indices_are_sorted=True`` scatter fast path survives the split
+    layout (a straddling chunk would silently break the hint). Padding
+    rows repeat each segment's last row (sorted, in-bounds, masked out by
+    ``row_valid``). Without a split this is the chunk_spec/pad_index
+    layout expressed as a gather. Cost: at most one extra chunk (plus one
+    chunk of pad rows) versus the unaligned layout.
+    """
+    if e_cap == 0:
+        return (np.zeros(0, np.int32), np.zeros(0, bool), 1, 0)
+    if e_split is not None and 0 <= e_split < e_cap:
+        segments = [(0, e_split), (e_split, e_cap)]
+    else:
+        segments = [(0, e_cap)]
+    longest = max(b - a for a, b in segments)
+    chunk = longest if chunk <= 0 else min(chunk, longest)
+    idx, valid = [], []
+    for a, b in segments:
+        n = b - a
+        if n == 0:
+            continue
+        pad = -(-n // chunk) * chunk - n
+        idx.append(np.arange(a, b, dtype=np.int32))
+        valid.append(np.ones(n, dtype=bool))
+        if pad:
+            idx.append(np.full(pad, b - 1, dtype=np.int32))
+            valid.append(np.zeros(pad, dtype=bool))
+    row_index = np.concatenate(idx)
+    row_valid = np.concatenate(valid)
+    return row_index, row_valid, len(row_index) // chunk, chunk
 
 
 def chunk_spec(e_cap: int, chunk: int):

@@ -1,0 +1,88 @@
+"""Collective-count auditing of traced programs.
+
+The overlap-aware halo pipeline's first-order win is COUNT: one coalesced
+``ppermute`` per ring shift per sync point instead of one per (shift,
+array), and zero extra forwards for sitewise readouts. This module makes
+that measurable without a chip — it walks a traced jaxpr (recursing into
+pjit/remat/scan/cond sub-jaxprs) and tallies collective primitives, with a
+best-effort grouping by ``jax.named_scope`` name stacks so the per-layer
+structure is visible. Feeds the ``collective_count`` telemetry field, the
+jaxpr-level regression tests (tests/test_halo_overlap.py) and the
+``tools/halo_audit.py`` CLI.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import jax
+
+# collective primitives the graph runtime can emit (names as they appear
+# in jaxprs across the jax versions this repo supports)
+COLLECTIVE_PRIMS = frozenset({
+    "ppermute", "psum", "psum2", "all_gather", "all_to_all",
+    "reduce_scatter", "pmax", "pmin", "pgather", "collective_permute",
+})
+
+
+def _iter_eqns(jaxpr):
+    """Yield every eqn in ``jaxpr`` and all nested sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(params):
+    """Collect Jaxpr/ClosedJaxpr values from an eqn's params (fallback for
+    jax versions without jax.core.jaxprs_in_params)."""
+    out = []
+
+    def visit(v):
+        if hasattr(v, "eqns"):           # Jaxpr
+            out.append(v)
+        elif hasattr(v, "jaxpr"):        # ClosedJaxpr
+            out.append(v.jaxpr)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                visit(x)
+
+    for v in params.values():
+        visit(v)
+    return out
+
+
+def count_collectives(closed_jaxpr) -> Counter:
+    """Counter of collective primitive name -> occurrence count over the
+    whole program (nested jaxprs included). scan bodies count ONCE per
+    trace — multiply by trip count yourself if you need dynamic totals."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    counts: Counter = Counter()
+    for eqn in _iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            counts[name] += 1
+    return counts
+
+
+def collective_counts(fn, *args, **kwargs) -> Counter:
+    """Trace ``fn(*args, **kwargs)`` (without executing it) and count its
+    collectives."""
+    return count_collectives(jax.make_jaxpr(fn)(*args, **kwargs))
+
+
+def ppermutes_by_scope(closed_jaxpr) -> Counter:
+    """Counter of name-stack string -> ppermute count (best effort: name
+    stacks are source metadata and may be absent on some jax builds, in
+    which case everything lands under "<unknown>")."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    by_scope: Counter = Counter()
+    for eqn in _iter_eqns(jaxpr):
+        if eqn.primitive.name not in ("ppermute", "collective_permute"):
+            continue
+        try:
+            scope = str(eqn.source_info.name_stack) or "<toplevel>"
+        except Exception:  # noqa: BLE001 - metadata is optional
+            scope = "<unknown>"
+        by_scope[scope] += 1
+    return by_scope

@@ -9,6 +9,24 @@ Padded recv indices point one past the array end, so XLA's
 drop-out-of-bounds scatter discards them. ``jax.grad`` transposes the
 ppermute automatically, which is exactly the reverse force flow the reference
 gets from torch autograd through device copies (reference pes.py:121-124).
+
+Two exchange implementations coexist behind ``halo_mode``:
+
+- ``"coalesced"`` (default): ONE ``ppermute`` per ring shift per sync
+  point, no matter how many feature arrays are refreshed together. All
+  arrays' masked payloads are flattened and concatenated into a single
+  flat buffer per shift (atom + bond features ride the same collective),
+  and all shifts' received rows land in one scatter. This is the payload
+  half of the overlap-aware pipeline: fewer, larger collectives expose the
+  latency XLA's async-collective scheduler can hide behind interior edge
+  compute (see ``LocalGraph.overlapped_edge_sum``).
+- ``"legacy"``: the historical per-shift, per-array loop — one gather /
+  ppermute / scatter round per (shift, array). Kept for A/B equivalence
+  testing; results are identical (set-scatter of the same rows).
+
+The two orders are interchangeable because send rows are always OWNED
+locals and recv slots are always HALO locals — no scatter ever feeds a
+later gather within one sync point.
 """
 
 from __future__ import annotations
@@ -20,7 +38,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.segment import masked_segment_sum
 from ..telemetry import scope
+
+HALO_MODES = ("coalesced", "legacy")
+
+
+def validate_halo_mode(halo_mode: str) -> str:
+    """Shared guard for every halo_mode entry point; returns the mode."""
+    if halo_mode not in HALO_MODES:
+        raise ValueError(
+            f"halo_mode={halo_mode!r}: expected one of {HALO_MODES}")
+    return halo_mode
 
 if hasattr(lax, "axis_size"):  # jax >= 0.6
     _axis_size = lax.axis_size
@@ -31,7 +60,8 @@ else:  # 0.4.x: axis_frame(name) resolves to the (static) size
 
 
 def _exchange(feats, send_idx, send_mask, recv_idx, shifts, axis_name):
-    """One round of halo exchange on a local feature array (N_cap, ...)."""
+    """Legacy round: one gather->ppermute->scatter per shift (S collectives
+    per array)."""
     if not shifts or axis_name is None:
         return feats
     n_dev = _axis_size(axis_name)
@@ -50,6 +80,55 @@ def _exchange(feats, send_idx, send_mask, recv_idx, shifts, axis_name):
     return feats
 
 
+def _coalesced_round(groups, shifts, axis_name):
+    """Coalesced round: ONE ppermute per ring shift for ALL groups.
+
+    ``groups``: list of ``(feats, send_idx, send_mask, recv_idx)`` with
+    per-shift tables shaped (S, H). Every group's masked payload is
+    flattened to (S, H*F) and concatenated into one (S, sum H*F) buffer —
+    mixed feature widths cost nothing (flat concat, no padding) and mixed
+    dtypes are promoted to the widest (bf16 rides fp32 losslessly) and cast
+    back on receive. Returns the updated feats list.
+
+    Valid because send rows are owned locals and recv slots are halo
+    locals: gathering every payload before any scatter reads exactly the
+    rows the legacy sequential loop reads.
+    """
+    if not shifts or axis_name is None:
+        return [g[0] for g in groups]
+    n_dev = _axis_size(axis_name)
+    S = len(shifts)
+    dtype = jnp.result_type(*[g[0].dtype for g in groups])
+    flats, shapes = [], []
+    for feats, send_idx, send_mask, _ in groups:
+        payload = feats[send_idx]                      # (S, H, *F)
+        m = send_mask.astype(feats.dtype).reshape(
+            send_mask.shape + (1,) * (feats.ndim - 1))
+        payload = payload * m
+        shapes.append(payload.shape)
+        flats.append(payload.astype(dtype).reshape(S, -1))
+    with scope("halo/coalesce"):
+        buf = flats[0] if len(flats) == 1 else jnp.concatenate(flats, axis=1)
+    received = []
+    for si, shift in enumerate(shifts):
+        perm = [(p, (p + shift) % n_dev) for p in range(n_dev)]
+        with scope(f"halo/shift{shift}"), scope("ppermute"):
+            received.append(lax.ppermute(buf[si], axis_name, perm))
+    recv = received[0][None] if S == 1 else jnp.stack(received)  # (S, total)
+    out, off = [], 0
+    for (feats, _, _, recv_idx), shp in zip(groups, shapes):
+        sz = 1
+        for d in shp[1:]:
+            sz *= int(d)
+        seg = recv[:, off:off + sz].reshape(shp).astype(feats.dtype)
+        off += sz
+        # one scatter across all shifts: from-sections are disjoint per
+        # source partition; padded slots point past the array end (dropped)
+        rows = seg.reshape((-1,) + shp[2:])
+        out.append(feats.at[recv_idx.reshape(-1)].set(rows, mode="drop"))
+    return out
+
+
 @dataclass
 class LocalGraph:
     """Per-shard view of a PartitionedGraph (leading P axis squeezed away).
@@ -57,6 +136,14 @@ class LocalGraph:
     Passed to model functions inside ``shard_map``; carries the local edge
     lists, masks, halo tables, and the collective axis name. Models call the
     methods below instead of touching collectives directly.
+
+    Edge layout contract: ``edge_dst`` is nondecreasing within each of the
+    interior ``[0, e_split)`` and frontier ``[e_split, e_cap)`` segments
+    (``indices_are_sorted`` segment sums per segment — use
+    ``aggregate_edges``/``overlapped_edge_sum``, never a raw full-array
+    sorted segment sum when ``has_frontier_split``). Interior edges read
+    only owned rows; frontier edges read halo src rows. Same contract for
+    ``line_dst`` (unsplit, globally sorted).
     """
 
     axis_name: str | None
@@ -68,9 +155,9 @@ class LocalGraph:
     node_mask: Any
     owned_mask: Any
     edge_src: Any
-    edge_dst: Any       # CONTRACT: nondecreasing (models rely on
-    edge_offset: Any    # indices_are_sorted=True segment sums); same for
-    edge_mask: Any      # line_dst — established by build_partitioned_graph
+    edge_dst: Any       # CONTRACT: nondecreasing within each edge segment
+    edge_offset: Any    # (see class docstring); line_dst globally sorted —
+    edge_mask: Any      # established by build_partitioned_graph
     halo_send_idx: Any
     halo_send_mask: Any
     halo_recv_idx: Any
@@ -88,25 +175,80 @@ class LocalGraph:
     bond_halo_send_mask: Any = None
     bond_halo_recv_idx: Any = None
     system: Any = None  # replicated per-system scalars (charge/spin/dataset)
+    # interior/frontier edge split (PartitionedGraph.e_split); < 0 or
+    # == e_cap means unsplit
+    e_split: int = -1
+    halo_mode: str = "coalesced"
+
+    @property
+    def has_frontier_split(self) -> bool:
+        return 0 <= self.e_split < self.e_cap
+
+    def _node_tables(self):
+        return (self.halo_send_idx, self.halo_send_mask, self.halo_recv_idx)
+
+    def _bond_tables(self):
+        return (self.bond_halo_send_idx, self.bond_halo_send_mask,
+                self.bond_halo_recv_idx)
 
     # ---- collectives ----
     def halo_exchange(self, feats):
         """Refresh halo (from-section) rows of a node feature array."""
         with scope("halo_exchange"):
-            return _exchange(
-                feats, self.halo_send_idx, self.halo_send_mask,
-                self.halo_recv_idx, self.shifts, self.axis_name,
-            )
+            if self.halo_mode == "legacy":
+                return _exchange(feats, *self._node_tables(), self.shifts,
+                                 self.axis_name)
+            return _coalesced_round([(feats,) + self._node_tables()],
+                                    self.shifts, self.axis_name)[0]
 
     def bond_halo_exchange(self, feats):
         """Refresh halo rows of a bond-node feature array."""
         if not self.has_bond_graph:
             return feats
         with scope("bond_halo_exchange"):
-            return _exchange(
-                feats, self.bond_halo_send_idx, self.bond_halo_send_mask,
-                self.bond_halo_recv_idx, self.shifts, self.axis_name,
-            )
+            if self.halo_mode == "legacy":
+                return _exchange(feats, *self._bond_tables(), self.shifts,
+                                 self.axis_name)
+            return _coalesced_round([(feats,) + self._bond_tables()],
+                                    self.shifts, self.axis_name)[0]
+
+    def exchange_all(self, node_feats=(), bond_feats=()):
+        """Refresh several feature arrays at one sync point.
+
+        In ``"coalesced"`` mode every array rides the SAME ppermute (one
+        collective per ring shift total — CHGNet's per-block atom+bond
+        refresh pays 1 instead of 2); in ``"legacy"`` mode this is just the
+        per-array loop. Returns ``(node_feats_out, bond_feats_out)`` tuples
+        in input order. Bond arrays pass through untouched when the graph
+        has no bond graph.
+        """
+        node_feats = tuple(node_feats)
+        bond_feats = tuple(bond_feats)
+        use_bond = self.has_bond_graph
+        if self.axis_name is None or not self.shifts:
+            return node_feats, bond_feats
+        with scope("halo_exchange_all"):
+            if self.halo_mode == "legacy":
+                nodes = tuple(
+                    _exchange(f, *self._node_tables(), self.shifts,
+                              self.axis_name) for f in node_feats)
+                bonds = tuple(
+                    _exchange(f, *self._bond_tables(), self.shifts,
+                              self.axis_name) if use_bond else f
+                    for f in bond_feats)
+                return nodes, bonds
+            groups = [(f,) + self._node_tables() for f in node_feats]
+            groups += [(f,) + self._bond_tables()
+                       for f in bond_feats if use_bond]
+            if not groups:
+                return node_feats, bond_feats
+            out = _coalesced_round(groups, self.shifts, self.axis_name)
+            nodes = tuple(out[: len(node_feats)])
+            if use_bond:
+                bonds = tuple(out[len(node_feats):])
+            else:
+                bonds = bond_feats
+            return nodes, bonds
 
     def psum(self, x):
         if self.axis_name is None:
@@ -119,6 +261,76 @@ class LocalGraph:
         lat = self.lattice if lattice is None else lattice
         disp = positions[self.edge_dst] - positions[self.edge_src]
         return disp + self.edge_offset.astype(positions.dtype) @ lat
+
+    # ---- edge aggregation (interior/frontier aware) ----
+    def aggregate_edges(self, data, mask=None):
+        """Segment-sum per-edge rows onto their dst nodes ((n_cap, ...)).
+
+        Honors the interior/frontier layout: each segment is dst-sorted,
+        the concatenation is NOT — so the sorted fast path runs per
+        segment. This is the drop-in replacement for the historical
+        full-array ``masked_segment_sum(..., indices_are_sorted=True)``.
+        """
+        if not self.has_frontier_split:
+            return masked_segment_sum(data, self.edge_dst, self.n_cap, mask,
+                                      indices_are_sorted=True)
+        s = self.e_split
+        out = masked_segment_sum(
+            data[:s], self.edge_dst[:s], self.n_cap,
+            None if mask is None else mask[:s], indices_are_sorted=True)
+        return out + masked_segment_sum(
+            data[s:], self.edge_dst[s:], self.n_cap,
+            None if mask is None else mask[s:], indices_are_sorted=True)
+
+    def chunk_sorted(self, chunk: int) -> bool:
+        """Whether every ``chunk``-row slice of ``edge_dst`` is
+        nondecreasing — the per-chunk ``indices_are_sorted`` hint for the
+        edge-chunked models (MACE/eSCN). True when the layout is unsplit or
+        the split boundary lands on a chunk boundary; otherwise exactly one
+        chunk straddles the interior->frontier reset and the hint must be
+        dropped (correctness over the scatter fast path)."""
+        if not self.has_frontier_split or chunk <= 0:
+            return True
+        return self.e_split % chunk == 0
+
+    def overlapped_edge_sum(self, msg_fn, v_pre, v_post, edge_data=(),
+                            mask=None):
+        """Per-edge messages summed to dst with interior/frontier split
+        scheduling.
+
+        ``v_post = halo_exchange(v_pre)`` is the freshly exchanged node
+        array. Interior edges gather src AND dst from ``v_pre`` (identical
+        rows — both endpoints are owned — but data-independent of the
+        in-flight ppermute), so XLA's async-collective scheduler can run
+        their gathers, GEMMs and segment sum while the exchange is on the
+        wire; frontier edges run on ``v_post`` after it lands.
+
+        ``msg_fn(v_src, v_dst, *edge_slices) -> (rows, ...)`` is invoked
+        once per segment; ``edge_data`` arrays are sliced alongside.
+        """
+        with scope("overlapped_edge_sum"):
+            if not self.has_frontier_split:
+                msg = msg_fn(v_post[self.edge_src], v_post[self.edge_dst],
+                             *edge_data)
+                return masked_segment_sum(msg, self.edge_dst, self.n_cap,
+                                          mask, indices_are_sorted=True)
+            s = self.e_split
+            out = None
+            for name, sl, v in (("interior", slice(0, s), v_pre),
+                                ("frontier", slice(s, None), v_post)):
+                with scope(f"edges/{name}"):
+                    # dst rows are always owned: read them from v_pre in
+                    # BOTH segments so only the frontier src gather waits
+                    # on the collective
+                    msg = msg_fn(v[self.edge_src[sl]],
+                                 v_pre[self.edge_dst[sl]],
+                                 *[d[sl] for d in edge_data])
+                    part = masked_segment_sum(
+                        msg, self.edge_dst[sl], self.n_cap,
+                        None if mask is None else mask[sl],
+                        indices_are_sorted=True)
+                out = part if out is None else out + part
+            return out
 
     # ---- bond-graph index remaps (reference dist.py:635-702 analogue) ----
     def edge_to_bond(self, edge_feats, bond_feats):
@@ -151,12 +363,16 @@ class LocalGraph:
             return self.psum(local)
 
 
-def local_graph_from_stacked(g, axis_name: str | None) -> tuple[LocalGraph, Any]:
+def local_graph_from_stacked(
+    g, axis_name: str | None, halo_mode: str = "coalesced",
+) -> tuple[LocalGraph, Any]:
     """Build a LocalGraph from shard-local (1, ...) slices of a PartitionedGraph.
 
     Returns (local_graph, positions_local) where positions keep their leading
-    1-axis squeezed.
+    1-axis squeezed. ``halo_mode`` selects the exchange implementation
+    (``"coalesced"`` | ``"legacy"``, see module docstring).
     """
+    validate_halo_mode(halo_mode)
     sq = lambda a: a[0] if a is not None and hasattr(a, "shape") and a.ndim >= 1 else a
     lg = LocalGraph(
         axis_name=axis_name,
@@ -164,6 +380,8 @@ def local_graph_from_stacked(g, axis_name: str | None) -> tuple[LocalGraph, Any]
         n_cap=g.n_cap,
         e_cap=g.e_cap,
         b_cap=g.b_cap,
+        e_split=g.e_split,
+        halo_mode=halo_mode,
         species=sq(g.species),
         node_mask=sq(g.node_mask),
         owned_mask=sq(g.owned_mask),

@@ -10,11 +10,20 @@ hand-written backward.
 Model contract:
     model_energy_fn(params, lg: LocalGraph, positions) -> per-atom energies
 with shape (N_cap,); padded rows may hold garbage — the runtime masks them.
+
+Fused site readout (``aux=True``): the model function instead returns
+``(e_atoms, aux)`` where ``aux`` is a pytree of per-atom arrays (leading
+axis N_cap — e.g. CHGNet magmoms). The aux rides the SAME forward pass as
+the energy (``jax.value_and_grad(..., has_aux=True)``), so sitewise
+quantities no longer cost a second full forward the way the separate
+``make_site_fn`` program does.
+
+``halo_mode`` selects the halo-exchange implementation
+(``"coalesced"`` — one ppermute per ring shift per sync point — or the
+historical ``"legacy"`` per-array loop; see parallel/halo.py).
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -63,18 +72,25 @@ def graph_in_specs(graph: PartitionedGraph) -> PartitionedGraph:
     )
 
 
-def make_total_energy(model_energy_fn, mesh: Mesh | None):
-    """Sharded total-energy fn: (params, graph, positions, strain) -> scalar.
+def make_total_energy(model_energy_fn, mesh: Mesh | None,
+                      halo_mode: str = "coalesced", aux: bool = False):
+    """Sharded total-energy fn: (params, graph, positions, strain) -> scalar
+    (or (scalar, aux_pytree) with ``aux=True``).
 
     ``positions`` is (P, N_cap, 3); only owned rows are read — halo rows are
     refreshed in-jit by the halo exchange so that gradients flow back to the
     owning partition. ``strain`` is a (3, 3) symmetric strain applied to
-    positions and lattice (for stress).
+    positions and lattice (for stress). With ``aux=True`` the model fn must
+    return ``(e_atoms, aux)``; aux leaves keep their per-partition leading
+    layout ((P, N_cap, ...) outside the shard_map).
     """
+    from .halo import validate_halo_mode
+
+    validate_halo_mode(halo_mode)  # fail at build, not first trace
 
     def local_energy(params, strain, graph_local, positions):
         axis = GRAPH_AXIS if mesh is not None else None
-        lg, _ = local_graph_from_stacked(graph_local, axis)
+        lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode)
         dtype = positions.dtype
         with scope("apply_strain"):
             pos, lg.lattice = apply_strain(
@@ -82,8 +98,12 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None):
             )
         pos = lg.halo_exchange(pos)
         with scope("model_energy"):
-            e_atoms = model_energy_fn(params, lg, pos)
-        return lg.owned_sum(e_atoms.reshape(-1, 1))
+            out = model_energy_fn(params, lg, pos)
+        if aux:
+            e_atoms, aux_out = out
+            aux_out = jax.tree.map(lambda a: a[None], aux_out)
+            return lg.owned_sum(e_atoms.reshape(-1, 1)), aux_out
+        return lg.owned_sum(out.reshape(-1, 1))
 
     if mesh is None:
         def total_energy(params, graph, positions, strain):
@@ -96,11 +116,12 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None):
         return total_energy
 
     def total_energy(params, graph, positions, strain):
+        out_specs = (P(), P(GRAPH_AXIS)) if aux else P()
         sharded = shard_map(
             local_energy,
             mesh=mesh,
             in_specs=(P(), P(), graph_in_specs(graph), P(GRAPH_AXIS)),
-            out_specs=P(),
+            out_specs=out_specs,
             **_NO_CHECK,
         )
         return sharded(params, strain, graph, positions)
@@ -108,21 +129,29 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None):
     return total_energy
 
 
-def make_site_fn(model_site_fn, mesh: Mesh | None):
+def make_site_fn(model_site_fn, mesh: Mesh | None,
+                 halo_mode: str = "coalesced"):
     """Jitted sharded per-atom readout: (params, graph, positions) ->
     (P, N_cap) site values (e.g. CHGNet magmoms — reference
     PESCalculator_Dist's compute_magmom surface, implementations/matgl/
     ase.py:53-127). Halo rows are refreshed in-jit like the energy path;
     reassemble owned rows with HostGraphData.gather_owned.
 
-    Runs a SEPARATE forward pass from the energy program (magmom_fn is its
-    own readout path); fusing it as an aux output of the energy forward
-    would need model energy_fns to return aux — a known follow-up if
-    magmom-every-step MD becomes a hot path."""
+    .. deprecated::
+        This runs a SEPARATE forward pass from the energy program — for
+        magmom-every-step MD that doubles device time. Models exposing
+        ``energy_and_aux_fn`` (CHGNet) now ride the sitewise readout on the
+        energy forward via ``make_potential_fn(..., aux=True)``;
+        DistPotential prefers that path automatically. make_site_fn remains
+        for models without a fused readout and as the parity oracle for the
+        fused path (tests/test_halo_overlap.py)."""
+    from .halo import validate_halo_mode
+
+    validate_halo_mode(halo_mode)
 
     def local_site(params, graph_local, positions):
         axis = GRAPH_AXIS if mesh is not None else None
-        lg, _ = local_graph_from_stacked(graph_local, axis)
+        lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode)
         pos = lg.halo_exchange(positions[0])
         with scope("model_site"):
             return model_site_fn(params, lg, pos)[None]
@@ -151,33 +180,44 @@ def make_site_fn(model_site_fn, mesh: Mesh | None):
     return site_fn
 
 
-def make_potential_fn(model_energy_fn, mesh: Mesh | None, compute_stress: bool = True):
+def make_potential_fn(model_energy_fn, mesh: Mesh | None,
+                      compute_stress: bool = True,
+                      halo_mode: str = "coalesced", aux: bool = False):
     """Jitted (params, graph, positions) -> dict(energy, forces, stress).
 
     forces: (P, N_cap, 3) — per-partition owned rows (reassemble with
     HostGraphData.gather_owned); stress: (3, 3) in eV/Å^3, dE/deps / V.
+    With ``aux=True`` (fused site readout) the model fn returns
+    ``(e_atoms, aux)`` and the result dict gains an ``"aux"`` pytree of
+    (P, N_cap, ...) per-atom outputs computed on the SAME forward pass.
     """
-    total_energy = make_total_energy(model_energy_fn, mesh)
+    total_energy = make_total_energy(model_energy_fn, mesh,
+                                     halo_mode=halo_mode, aux=aux)
 
     @jax.jit
     def potential(params, graph, positions):
         strain = jnp.zeros((3, 3), dtype=positions.dtype)
+        grad_fn = jax.value_and_grad(
+            total_energy,
+            argnums=(2, 3) if compute_stress else 2,
+            has_aux=aux,
+        )
+        with scope("energy_and_grad"):
+            val, grads = grad_fn(params, graph, positions, strain)
+        energy, aux_out = val if aux else (val, None)
         if compute_stress:
-            with scope("energy_and_grad"):
-                (energy, (g_pos, g_strain)) = jax.value_and_grad(
-                    total_energy, argnums=(2, 3)
-                )(params, graph, positions, strain)
+            g_pos, g_strain = grads
             with scope("stress"):
                 vol = jnp.abs(jnp.linalg.det(graph.lattice.astype(
                     jnp.float64 if graph.lattice.dtype == jnp.float64
                     else positions.dtype)))
                 stress = g_strain / vol
         else:
-            with scope("energy_and_grad"):
-                energy, g_pos = jax.value_and_grad(total_energy, argnums=2)(
-                    params, graph, positions, strain
-                )
+            g_pos = grads
             stress = jnp.zeros((3, 3), dtype=positions.dtype)
-        return {"energy": energy, "forces": -g_pos, "stress": stress}
+        out = {"energy": energy, "forces": -g_pos, "stress": stress}
+        if aux:
+            out["aux"] = aux_out
+        return out
 
     return potential

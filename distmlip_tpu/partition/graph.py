@@ -51,7 +51,8 @@ _default_caps = CapacityPolicy()
         "n_total_nodes",
         "system",
     ],
-    meta_fields=["num_partitions", "shifts", "has_bond_graph", "n_cap", "e_cap", "b_cap"],
+    meta_fields=["num_partitions", "shifts", "has_bond_graph", "n_cap",
+                 "e_cap", "b_cap", "e_split"],
 )
 @dataclass
 class PartitionedGraph:
@@ -62,6 +63,14 @@ class PartitionedGraph:
     n_cap: int
     e_cap: int
     b_cap: int  # bond-node capacity (0 if no bond graph)
+    # interior/frontier edge split boundary: edges [0, e_split) have both
+    # endpoints locally owned (halo-independent — their messages can be
+    # computed while a halo exchange is still in flight); edges
+    # [e_split, e_cap) read halo src rows. e_split == e_cap means the
+    # layout is unsplit (single partition, or frontier_split=False) and
+    # edge_dst is globally nondecreasing; with a split, edge_dst is
+    # nondecreasing WITHIN each segment only.
+    e_split: int
 
     # --- per-partition arrays, leading axis P ---
     positions: Any          # (P, N_cap, 3) owned rows valid; halo rows filled in-jit
@@ -184,17 +193,40 @@ def build_partitioned_graph(
     caps: CapacityPolicy | None = None,
     dtype=np.float32,
     system: dict | None = None,
+    frontier_split: bool = True,
 ) -> tuple[PartitionedGraph, HostGraphData]:
     """Pad + stack a PartitionPlan into a PartitionedGraph pytree.
 
     ``system``: optional per-system scalars (charge, spin, dataset ints) —
     conditioning inputs for UMA-style models; defaults to zeros so the pytree
     structure is stable.
+
+    ``frontier_split``: lay edges out as [interior | frontier] segments
+    (each dst-sorted, separately padded) so interior edge compute can
+    overlap the in-flight halo ``ppermute`` (see ``PartitionedGraph.e_split``
+    and ``LocalGraph.aggregate_edges``). The reorder is exactness-preserving
+    — it is a permutation of the same edge set with the same per-segment
+    sorted-dst contract. Set False for the historical single-segment layout
+    (globally dst-sorted edges).
     """
     caps = caps or _default_caps
     P = plan.num_partitions
     n_cap = caps.get("nodes", max(int(m[-1]) for m in plan.node_markers))
-    e_cap = caps.get("edges", max(len(e) for e in plan.edge_ids))
+    frontier = [plan.edge_is_frontier(p) for p in range(P)]
+    split = frontier_split and any(f.any() for f in frontier)
+    if split:
+        # separate sticky caps per segment: e_cap must hold the worst-case
+        # interior AND frontier counts even when they peak on different
+        # partitions, so the boundary (e_split) is a single static index
+        # shared by every shard's program
+        e_split = caps.get(
+            "edges_interior", max(int((~f).sum()) for f in frontier))
+        f_cap = caps.get(
+            "edges_frontier", max(int(f.sum()) for f in frontier))
+        e_cap = e_split + f_cap
+    else:
+        e_cap = caps.get("edges", max(len(e) for e in plan.edge_ids))
+        e_split = e_cap  # unsplit: one globally dst-sorted segment
 
     positions = np.zeros((P, n_cap, 3), dtype=dtype)
     spec = np.zeros((P, n_cap), dtype=np.int32)
@@ -209,8 +241,9 @@ def build_partitioned_graph(
     # reported relative to it, so MD positions drift out of the box freely
     input_cart = nl.wrapped_cart + nl.shift @ np.asarray(lattice, dtype=np.float64)
     owned_counts = plan.owned_counts
-    # per-partition edges sorted by dst so segment reductions see sorted
-    # indices (TPU-friendly); bond_map edge indices are remapped to match
+    # per-partition edges sorted by dst within each (interior, frontier)
+    # segment so segment reductions see sorted indices (TPU-friendly);
+    # bond_map edge indices are remapped to match
     edge_perm_inv = []
     for p in range(P):
         g = plan.global_ids[p]
@@ -221,18 +254,42 @@ def build_partitioned_graph(
         owned_mask[p, : owned_counts[p]] = True
         ne = len(plan.edge_ids[p])
         perm = np.argsort(plan.dst_local[p], kind="stable")
+        if split:
+            # stable-partition the dst-sorted order: interior first, then
+            # frontier — each segment stays dst-sorted
+            perm = perm[np.argsort(frontier[p][perm], kind="stable")]
+        n_int = ne - int(frontier[p].sum()) if split else ne
+        # padded slot of sorted edge k: interior edges fill [0, n_int),
+        # frontier edges fill [e_split, e_split + n_fr)
+        slot = np.arange(ne, dtype=np.int64)
+        slot[n_int:] += e_split - n_int
         inv = np.empty(ne, dtype=np.int64)
-        inv[perm] = np.arange(ne)
+        inv[perm] = slot
         edge_perm_inv.append(inv)
-        edge_src[p, :ne] = plan.src_local[p][perm]
-        edge_dst[p, :ne] = plan.dst_local[p][perm]
-        # pad dst with the last real value: keeps the array sorted for the
-        # segment-sum fast path, stays in-bounds for eager gathers; masked
-        # messages are zeroed so the extra segment contributions are 0
-        edge_dst[p, ne:] = plan.dst_local[p][perm][-1] if ne else 0
-        edge_offset[p, :ne] = plan.edge_offsets[p][perm]
-        edge_mask[p, :ne] = True
-        assert np.all(np.diff(edge_dst[p]) >= 0), "edge_dst must be sorted"
+        # (edges in sorted order, start slot in padded array, segment cap end)
+        segments = (
+            (perm[:n_int], 0, e_split),
+            (perm[n_int:], e_split, e_cap),
+        )
+        for seg, start, cap_end in segments:
+            k = len(seg)
+            edge_src[p, start:start + k] = plan.src_local[p][seg]
+            edge_dst[p, start:start + k] = plan.dst_local[p][seg]
+            edge_offset[p, start:start + k] = plan.edge_offsets[p][seg]
+            edge_mask[p, start:start + k] = True
+            # pad dst with the segment's last real value: keeps each segment
+            # nondecreasing for the segment-sum fast path, stays in-bounds
+            # for eager gathers; masked messages are zeroed so the extra
+            # segment contributions are 0
+            edge_dst[p, start + k:cap_end] = (
+                plan.dst_local[p][seg[-1]] if k else 0)
+        assert np.all(np.diff(edge_dst[p, :e_split]) >= 0), \
+            "interior edge_dst must be sorted"
+        assert np.all(np.diff(edge_dst[p, e_split:]) >= 0), \
+            "frontier edge_dst must be sorted"
+        if split:
+            assert np.all(plan.src_local[p][perm[:n_int]] < owned_counts[p]), \
+                "interior edges must not read halo rows"
 
     shifts, h_send, h_smask, h_recv = _halo_tables(
         plan, plan.section, n_cap, caps, "halo",
@@ -306,6 +363,7 @@ def build_partitioned_graph(
         n_cap=n_cap,
         e_cap=e_cap,
         b_cap=b_cap,
+        e_split=e_split,
         positions=positions,
         species=spec,
         node_mask=node_mask,
@@ -349,7 +407,9 @@ def graph_build_stats(graph: PartitionedGraph) -> dict:
     transfer. Keys mirror StepRecord's graph fields.
     """
     nodes = np.asarray(graph.node_mask).sum(axis=1)
-    edges = np.asarray(graph.edge_mask).sum(axis=1)
+    edge_mask = np.asarray(graph.edge_mask)
+    edges = edge_mask.sum(axis=1)
+    frontier = edge_mask[:, graph.e_split:].sum(axis=1)
     send = np.asarray(graph.halo_send_mask).sum(axis=(0, 2))
     recv = (np.asarray(graph.halo_recv_idx) < graph.n_cap).sum(axis=(0, 2))
     stats = {
@@ -362,10 +422,17 @@ def graph_build_stats(graph: PartitionedGraph) -> dict:
         "n_edges_per_part": [int(x) for x in edges],
         "node_occupancy": float(nodes.max() / graph.n_cap) if graph.n_cap else 0.0,
         "edge_occupancy": float(edges.max() / graph.e_cap) if graph.e_cap else 0.0,
+        # fraction of real edges that must wait on the halo exchange (the
+        # non-overlappable tail of each layer); worst partition
+        "frontier_edge_frac": float(
+            (frontier / np.maximum(edges, 1)).max()) if len(edges) else 0.0,
         "halo_send_per_part": [int(x) for x in send],
         "halo_recv_per_part": [int(x) for x in recv],
     }
     if graph.has_bond_graph:
         bsend = np.asarray(graph.bond_halo_send_mask).sum(axis=(0, 2))
         stats["bond_halo_send_per_part"] = [int(x) for x in bsend]
+        # total live line-graph edges (angle terms) — the FLOP model's
+        # third graph dimension
+        stats["n_lines"] = int(np.asarray(graph.line_mask).sum())
     return stats

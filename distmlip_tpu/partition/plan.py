@@ -70,13 +70,47 @@ class PartitionPlan:
     bond_mapping_bond: list = field(default_factory=list)  # [p] -> (M_p,) local bond ids
 
     @property
+    def kind(self) -> str:
+        """Layout family of this plan: ``"single"`` (P == 1), ``"slab"``
+        (1-D slabs, per-peer to/from marker sections), or ``"block"``
+        (grid decomposition, explicit halo_send/halo_recv lists).
+
+        Block plans REUSE the marker vector shape but not its semantics:
+        their layout is [pure | border-as-to_0 | (empty to_q)... | from_*],
+        because block send sets overlap and cannot be contiguous per-peer
+        sections. ``owned_counts`` is valid for every kind; per-peer
+        ``section``/``bond_section`` lookups are slab-only and guarded.
+        """
+        if self.grid is not None or self.halo_send is not None:
+            return "block"
+        return "single" if self.num_partitions == 1 else "slab"
+
+    @property
     def owned_counts(self) -> np.ndarray:
         """Number of owned (pure + to) nodes per partition."""
         P = self.num_partitions
         return np.array([m[1 + P] for m in self.node_markers])
 
+    def edge_is_frontier(self, p: int) -> np.ndarray:
+        """(E_p,) bool — edges whose src row is a halo node (dst is always
+        owned under owner-computes). Interior edges (both endpoints owned)
+        can be computed while a halo exchange is still in flight; frontier
+        edges must wait for the refreshed rows."""
+        oc = int(self.owned_counts[p])
+        return np.asarray(self.src_local[p]) >= oc
+
+    def _check_slab_markers(self, what: str) -> None:
+        if self.kind == "block":
+            raise ValueError(
+                f"{what}: block plans have no per-peer marker sections "
+                "(their node_markers layout is [pure | border | from_*]); "
+                "use plan.halo_send/halo_recv (or bond_halo_*) instead."
+            )
+
     def section(self, p: int, kind: str, q: int) -> tuple[int, int]:
-        """Local index range of a section: kind in {'to','from'}, peer q."""
+        """Local index range of a section: kind in {'to','from'}, peer q.
+        Slab/single plans only — see ``kind``."""
+        self._check_slab_markers(f"section(p={p}, {kind!r}, q={q})")
         P = self.num_partitions
         m = self.node_markers[p]
         if kind == "to":
@@ -86,6 +120,7 @@ class PartitionPlan:
         raise ValueError(kind)
 
     def bond_section(self, p: int, kind: str, q: int) -> tuple[int, int]:
+        self._check_slab_markers(f"bond_section(p={p}, {kind!r}, q={q})")
         P = self.num_partitions
         m = self.bond_markers[p]
         if kind == "to":

@@ -53,6 +53,15 @@ class StepRecord:
     n_edges_per_part: list[int] = field(default_factory=list)
     node_occupancy: float = 0.0      # max real nodes / n_cap over partitions
     edge_occupancy: float = 0.0      # max real edges / e_cap over partitions
+    # fraction of real edges that wait on the halo exchange (worst
+    # partition) — the non-overlappable tail of the interior/frontier split
+    frontier_edge_frac: float = 0.0
+
+    # --- halo pipeline + device-program cost model ---
+    halo_mode: str = ""              # coalesced | legacy ("" = unknown)
+    collective_count: int = 0        # collectives in the traced step program
+    flops_per_step: float = 0.0      # analytic estimate (utils/flops.py)
+    mfu: float = 0.0                 # flops / (device_s * devices * peak)
 
     # --- halo volumes (rows exchanged per partition, summed over shifts) ---
     halo_send_per_part: list[int] = field(default_factory=list)
@@ -63,6 +72,7 @@ class StepRecord:
     graph_reused: bool = False       # skin cache hit (positions-only scatter)
     rebuild: bool = False            # this step built/adopted a new graph
     prefetch_adopted: bool = False   # rebuild absorbed by the background build
+    prefetch_skipped_hbm: bool = False  # speculative build vetoed: HBM guard
     compile_cache_size: int = 0      # jit executable cache entries after step
     compiled: bool = False           # this step triggered an XLA compile
 

@@ -75,6 +75,21 @@ class Report:
         if "max_halo_imbalance" in c:
             out.append(f"halo send imbalance (max/mean over partitions): "
                        f"worst={c['max_halo_imbalance']:.2f}")
+        if "halo_modes" in c or "collective_count" in c:
+            bits = []
+            if "halo_modes" in c:
+                bits.append(f"halo_mode={','.join(c['halo_modes'])}")
+            if "collective_count" in c:
+                bits.append(f"collectives/step={c['collective_count']}")
+            if "mean_frontier_edge_frac" in c:
+                bits.append(
+                    f"frontier_edge_frac={c['mean_frontier_edge_frac']:.3f}")
+            out.append("halo pipeline: " + " ".join(bits))
+        if "mean_mfu" in c:
+            out.append(f"mfu: mean={c['mean_mfu']:.3f} max={c['max_mfu']:.3f}")
+        if c.get("prefetch_skipped_hbm"):
+            out.append(f"prefetch skipped by HBM guard: "
+                       f"{c['prefetch_skipped_hbm']} step(s)")
         if self.anomalies:
             out.append("")
             out.append(f"ANOMALIES ({len(self.anomalies)}):")
@@ -127,6 +142,22 @@ def aggregate(
     imb = [r.halo_imbalance() for r in records if r.halo_send_per_part]
     if imb:
         c["max_halo_imbalance"] = max(imb)
+    # overlap pipeline + cost model (0-valued fields = producer didn't know)
+    modes = sorted({r.halo_mode for r in records if r.halo_mode})
+    if modes:
+        c["halo_modes"] = modes
+    colls = [r.collective_count for r in records if r.collective_count > 0]
+    if colls:
+        c["collective_count"] = max(colls)
+    fr = [r.frontier_edge_frac for r in records if r.frontier_edge_frac > 0]
+    if fr:
+        c["mean_frontier_edge_frac"] = sum(fr) / len(fr)
+    mfus = [r.mfu for r in records if r.mfu > 0]
+    if mfus:
+        c["mean_mfu"] = sum(mfus) / len(mfus)
+        c["max_mfu"] = max(mfus)
+    c["prefetch_skipped_hbm"] = sum(
+        getattr(r, "prefetch_skipped_hbm", False) for r in records)
 
     # --- anomalies ---
     # stall detection is PER KIND: a DeviceMD chunk legitimately takes

@@ -1,4 +1,6 @@
 from .checkpoint import save_params, load_params
+from .flops import mfu, model_flop_estimate, peak_flops_per_device
 from .profiling import StepTimer, device_trace
 
-__all__ = ["save_params", "load_params", "StepTimer", "device_trace"]
+__all__ = ["save_params", "load_params", "StepTimer", "device_trace",
+           "model_flop_estimate", "peak_flops_per_device", "mfu"]

@@ -1,0 +1,401 @@
+"""Overlap-aware halo pipeline (ISSUE 2): coalesced ppermute payloads,
+interior/frontier edge split, and the fused site readout.
+
+Three certification surfaces:
+- jaxpr-level collective counts — the coalesced path emits exactly ONE
+  ppermute per exchange round, and a full magmom MD step pays >= 2x fewer
+  collectives than the legacy (per-array exchange + separate site forward)
+  pipeline;
+- numerical equivalence — halo_mode="coalesced" / "legacy" /
+  single-partition agree on energy/forces/stress, gradients still flow to
+  the owning partition, and the interior/frontier reorder is an exact
+  permutation of the unsplit edge list;
+- fused readout parity — energy_and_aux_fn magmoms match make_site_fn
+  without a second forward pass.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distmlip_tpu.models.chgnet import CHGNet, CHGNetConfig
+from distmlip_tpu.models.pair import PairConfig, PairPotential
+from distmlip_tpu.neighbors import neighbor_list_numpy
+from distmlip_tpu.parallel import (GRAPH_AXIS, graph_in_specs, graph_mesh,
+                                   make_potential_fn, make_site_fn)
+from distmlip_tpu.parallel.audit import (count_collectives,
+                                         ppermutes_by_scope)
+from distmlip_tpu.parallel.halo import local_graph_from_stacked
+from distmlip_tpu.parallel.runtime import _NO_CHECK, shard_map
+from distmlip_tpu.partition import (CapacityPolicy, build_partitioned_graph,
+                                    build_plan)
+from tests.utils import make_crystal
+
+CFG = CHGNetConfig(
+    num_species=4, units=16, num_rbf=6, num_angle=4, num_blocks=3,
+    cutoff=3.2, bond_cutoff=2.6,
+)
+A_LAT = 3.5
+MODEL = CHGNet(CFG)
+PAIR = PairPotential(PairConfig(cutoff=3.0))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return MODEL.init(jax.random.PRNGKey(0))
+
+
+def _system(rng, reps=(6, 3, 3)):
+    return make_crystal(rng, reps=reps, a=A_LAT)
+
+
+def _graph(system, nparts, bond=True, frontier_split=True, caps=None):
+    cart, lattice, species = system
+    bond_r = CFG.bond_cutoff if bond else 0.0
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], CFG.cutoff,
+                             bond_r=bond_r)
+    plan = build_plan(nl, lattice, [1, 1, 1], nparts, CFG.cutoff, bond_r,
+                      use_bond_graph=bond)
+    graph, host = build_partitioned_graph(
+        plan, nl, species, lattice, caps=caps or CapacityPolicy(),
+        frontier_split=frontier_split)
+    return cart, nl, plan, graph, host
+
+
+def _ppermute_count(fn, *args):
+    return count_collectives(jax.make_jaxpr(fn)(*args)).get("ppermute", 0)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-level collective counts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_coalesced_one_ppermute_per_exchange_round(rng, params):
+    """Each CHGNet sync point (atom+bond refresh together) emits exactly
+    ONE ppermute on a 2-partition graph (single ring shift): the forward
+    trunk's count equals its number of exchange rounds."""
+    cart, nl, plan, graph, host = _graph(_system(rng), 2)
+    mesh = graph_mesh(2)
+
+    def forward(params, graph, positions):
+        def local(g, pos):
+            lg, _ = local_graph_from_stacked(g, GRAPH_AXIS, "coalesced")
+            return MODEL.energy_fn(params, lg, pos[0])[None]
+
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(graph_in_specs(graph), P(GRAPH_AXIS)),
+            out_specs=P(GRAPH_AXIS), **_NO_CHECK,
+        )(graph, positions)
+
+    n = _ppermute_count(forward, params, graph, graph.positions)
+    # exchange rounds for num_blocks=3 with bond graph: 1 fused init
+    # (v + bond geometry) + per inner block (2 of them): 1 fused (v + b)
+    # + 1 bond-only = 5; the final atom conv re-uses the last exchange
+    assert n == 5, f"expected 5 coalesced exchange rounds, traced {n}"
+
+    # every ppermute sits under a halo scope (no stray collectives)
+    scopes = ppermutes_by_scope(jax.make_jaxpr(forward)(
+        params, graph, graph.positions))
+    assert sum(scopes.values()) == n
+
+
+@pytest.mark.tier1
+def test_collective_count_halves_for_magmom_step(rng, params):
+    """Acceptance: collectives per magmom-MD step drop >= 2x on a CHGNet
+    2-partition graph — legacy per-array exchanges + separate site forward
+    vs coalesced exchanges + fused aux readout."""
+    cart, nl, plan, graph, host = _graph(_system(rng), 2)
+    mesh = graph_mesh(2)
+
+    pot_legacy = make_potential_fn(MODEL.energy_fn, mesh, halo_mode="legacy")
+    site_legacy = make_site_fn(MODEL.magmom_fn, mesh, halo_mode="legacy")
+    pot_fused = make_potential_fn(MODEL.energy_and_aux_fn, mesh,
+                                  halo_mode="coalesced", aux=True)
+
+    args = (params, graph, graph.positions)
+    legacy = (_ppermute_count(pot_legacy, *args)
+              + _ppermute_count(site_legacy, *args))
+    fused = _ppermute_count(pot_fused, *args)
+    assert fused > 0
+    assert legacy / fused >= 2.0, (
+        f"collective reduction {legacy}/{fused} = {legacy / fused:.2f}x < 2x")
+
+
+@pytest.mark.tier1
+def test_fused_readout_adds_no_forward(rng, params):
+    """The aux (magmom) output rides the energy program: identical
+    collective and GEMM counts to the energy-only potential — i.e. no
+    second forward pass (compile-level certification)."""
+    cart, nl, plan, graph, host = _graph(_system(rng), 2)
+    mesh = graph_mesh(2)
+    args = (params, graph, graph.positions)
+
+    pot = make_potential_fn(MODEL.energy_fn, mesh)
+    pot_aux = make_potential_fn(MODEL.energy_and_aux_fn, mesh, aux=True)
+    assert _ppermute_count(pot_aux, *args) == _ppermute_count(pot, *args)
+
+    def dots(fn):
+        c = count_collectives(jax.make_jaxpr(fn)(*args))
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        from distmlip_tpu.parallel.audit import _iter_eqns
+
+        return sum(1 for e in _iter_eqns(jaxpr.jaxpr)
+                   if e.primitive.name == "dot_general"), c
+    n_dots, _ = dots(pot)
+    n_dots_aux, _ = dots(pot_aux)
+    # the sitewise linear adds exactly one extra (tiny) GEMM, nothing else
+    assert n_dots_aux - n_dots <= 1
+
+
+# ---------------------------------------------------------------------------
+# numerical equivalence
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_halo_modes_match_single_partition_chgnet(rng, params):
+    """energy/forces/stress agree <= 1e-5 (fp32) between coalesced, legacy
+    and single-partition on a bond-graph CHGNet system (acceptance
+    criterion)."""
+    caps = CapacityPolicy()
+    system = _system(rng)
+    outs = {}
+    for key, nparts, mode in (("single", 1, "coalesced"),
+                              ("coalesced", 2, "coalesced"),
+                              ("legacy", 2, "legacy")):
+        cart, nl, plan, graph, host = _graph(system, nparts, caps=caps)
+        mesh = graph_mesh(nparts) if nparts > 1 else None
+        pot = make_potential_fn(MODEL.energy_fn, mesh, halo_mode=mode)
+        out = pot(params, graph, graph.positions)
+        outs[key] = (
+            float(out["energy"]),
+            host.gather_owned(np.asarray(out["forces"]), len(cart)),
+            np.asarray(out["stress"]),
+        )
+    e0, f0, s0 = outs["single"]
+    assert np.abs(f0).max() > 1e-4  # non-degeneracy guard
+    for key in ("coalesced", "legacy"):
+        e, f, s = outs[key]
+        assert abs(e - e0) <= 1e-5 * max(1.0, abs(e0)), key
+        np.testing.assert_allclose(f, f0, atol=1e-5, err_msg=key)
+        np.testing.assert_allclose(s, s0, atol=1e-5, err_msg=key)
+    # coalesced vs legacy on the SAME graph: same math, same masks
+    np.testing.assert_allclose(outs["coalesced"][1], outs["legacy"][1],
+                               atol=1e-6)
+
+
+@pytest.mark.tier1
+def test_halo_modes_match_pair(rng):
+    p = PAIR.init()
+    caps = CapacityPolicy()
+    cart, lattice, species = make_crystal(rng, reps=(8, 3, 3), a=A_LAT)
+    outs = {}
+    for key, nparts, mode in (("single", 1, "coalesced"),
+                              ("coalesced", 4, "coalesced"),
+                              ("legacy", 4, "legacy")):
+        nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], PAIR.cfg.cutoff)
+        plan = build_plan(nl, lattice, [1, 1, 1], nparts, PAIR.cfg.cutoff)
+        graph, host = build_partitioned_graph(plan, nl, species, lattice,
+                                              caps=caps)
+        mesh = graph_mesh(nparts) if nparts > 1 else None
+        pot = make_potential_fn(PAIR.energy_fn, mesh, halo_mode=mode)
+        out = pot(p, graph, graph.positions)
+        outs[key] = (float(out["energy"]),
+                     host.gather_owned(np.asarray(out["forces"]), len(cart)))
+    e0, f0 = outs["single"]
+    for key in ("coalesced", "legacy"):
+        e, f = outs[key]
+        assert abs(e - e0) <= 1e-5 * max(1.0, abs(e0)), key
+        np.testing.assert_allclose(f, f0, atol=1e-5, err_msg=key)
+
+
+@pytest.mark.parametrize("mode", ["coalesced", "legacy"])
+def test_gradients_flow_to_owner_both_modes(rng, mode):
+    """d(sum of halo rows)/d(owned rows) is 1 at owner slots for BOTH
+    exchange implementations (the transposed-ppermute force flow)."""
+    nparts = 4
+    cart, lattice, species = make_crystal(rng, reps=(8, 2, 2), a=A_LAT)
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], 3.0)
+    plan = build_plan(nl, lattice, [1, 1, 1], nparts, 3.0)
+    graph, host = build_partitioned_graph(plan, nl, species, lattice)
+    mesh = graph_mesh(nparts)
+    n = len(cart)
+
+    def loss(graph_l, feats):
+        lg, _ = local_graph_from_stacked(graph_l, GRAPH_AXIS, mode)
+        full = lg.halo_exchange(feats[0])
+        halo_mask = lg.node_mask & ~lg.owned_mask
+        return jax.lax.psum(jnp.sum(full * halo_mask[:, None]), GRAPH_AXIS)
+
+    def total(feats):
+        return shard_map(
+            loss, mesh=mesh, in_specs=(graph_in_specs(graph), P(GRAPH_AXIS)),
+            out_specs=P(), **_NO_CHECK,
+        )(graph, feats)
+
+    local = jnp.asarray(host.scatter_global(
+        np.zeros((n, 2), np.float32), graph.n_cap))
+    g = np.asarray(jax.grad(total)(local))
+    for p in range(nparts):
+        m = plan.node_markers[p]
+        P_ = plan.num_partitions
+        np.testing.assert_allclose(g[p, : m[1]], 0.0)          # pure
+        np.testing.assert_allclose(g[p, m[1]: m[1 + P_]], 1.0)  # to-sections
+        np.testing.assert_allclose(g[p, m[1 + P_]:], 0.0)      # halo+pad
+
+
+def test_exchange_all_matches_sequential(rng):
+    """Coalescing N arrays into one ppermute delivers exactly what N
+    separate exchanges deliver — mixed widths and dtypes included."""
+    nparts = 2
+    cart, nl, plan, graph, host = _graph(_system(rng), nparts)
+    mesh = graph_mesh(nparts)
+    n = len(cart)
+    fa = rng.standard_normal((n, 5)).astype(np.float32)
+    fb = rng.standard_normal((n, 3)).astype(np.float32)
+    la = host.scatter_global(fa, graph.n_cap)
+    lb = host.scatter_global(fb, graph.n_cap)
+    for p in range(nparts):
+        oc = host.owned_counts[p]
+        la[p, oc:] = 0.0
+        lb[p, oc:] = 0.0
+
+    def run(mode):
+        def f(g, xa, xb):
+            lg, _ = local_graph_from_stacked(g, GRAPH_AXIS, mode)
+            (a, b), _ = lg.exchange_all(
+                (xa[0], xb[0].astype(jnp.bfloat16)), ())
+            return a[None], b.astype(jnp.float32)[None]
+
+        return shard_map(
+            f, mesh=mesh,
+            in_specs=(graph_in_specs(graph), P(GRAPH_AXIS), P(GRAPH_AXIS)),
+            out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS)), **_NO_CHECK,
+        )(graph, jnp.asarray(la), jnp.asarray(lb))
+
+    a_c, b_c = run("coalesced")
+    a_l, b_l = run("legacy")
+    np.testing.assert_array_equal(np.asarray(a_c), np.asarray(a_l))
+    np.testing.assert_array_equal(np.asarray(b_c), np.asarray(b_l))
+    # and the refreshed rows carry the owner's values
+    for p in range(nparts):
+        g_ids = plan.global_ids[p]
+        np.testing.assert_allclose(np.asarray(a_c)[p, : len(g_ids)],
+                                   fa[g_ids], atol=0)
+
+
+# ---------------------------------------------------------------------------
+# interior/frontier reorder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_frontier_reorder_is_exact_permutation(rng, params):
+    """The split layout holds the SAME edge set as the unsplit one, each
+    segment is dst-sorted, interior edges read no halo rows — and model
+    results agree with the unsplit layout."""
+    caps_a, caps_b = CapacityPolicy(), CapacityPolicy()
+    system = _system(rng)
+    cart, nl, plan, g_split, host = _graph(system, 2, caps=caps_a)
+    _, _, _, g_flat, host_flat = _graph(system, 2, frontier_split=False,
+                                        caps=caps_b)
+    assert g_split.has_bond_graph
+    assert 0 < g_split.e_split < g_split.e_cap
+    assert g_flat.e_split == g_flat.e_cap  # unsplit sentinel
+
+    for p in range(2):
+        oc = host.owned_counts[p]
+        mask = np.asarray(g_split.edge_mask[p])
+        src = np.asarray(g_split.edge_src[p])
+        dst = np.asarray(g_split.edge_dst[p])
+        s = g_split.e_split
+        # per-segment sorted (incl. padding contract)
+        assert np.all(np.diff(dst[:s]) >= 0)
+        assert np.all(np.diff(dst[s:]) >= 0)
+        # interior reads owned rows only; frontier src are halo rows
+        assert np.all(src[:s][mask[:s]] < oc)
+        assert np.all(src[s:][mask[s:]] >= oc)
+        # same (src, dst, offset) multiset as the unsplit layout
+        off = np.asarray(g_split.edge_offset[p])
+        flat_mask = np.asarray(g_flat.edge_mask[p])
+        flat_rows = np.stack(
+            [np.asarray(g_flat.edge_src[p])[flat_mask],
+             np.asarray(g_flat.edge_dst[p])[flat_mask]], axis=1)
+        split_rows = np.stack([src[mask], dst[mask]], axis=1)
+        assert flat_rows.shape == split_rows.shape
+        key = lambda rows: rows[np.lexsort(rows.T)]
+        np.testing.assert_array_equal(key(flat_rows), key(split_rows))
+        assert mask.sum() == flat_mask.sum()
+        assert np.all(np.abs(off[~mask]) == 0)
+
+    mesh = graph_mesh(2)
+    pot = make_potential_fn(MODEL.energy_fn, mesh)
+    out_s = pot(params, g_split, g_split.positions)
+    out_f = pot(params, g_flat, g_flat.positions)
+    f_s = host.gather_owned(np.asarray(out_s["forces"]), len(cart))
+    f_f = host_flat.gather_owned(np.asarray(out_f["forces"]), len(cart))
+    assert abs(float(out_s["energy"]) - float(out_f["energy"])) <= 1e-5
+    np.testing.assert_allclose(f_s, f_f, atol=1e-5)
+
+
+def test_aggregate_edges_matches_unsorted_reference(rng):
+    """LocalGraph.aggregate_edges == a plain unsorted segment_sum over the
+    same (data, dst, mask) — the per-segment sorted fast path changes
+    nothing."""
+    cart, nl, plan, graph, host = _graph(_system(rng), 2)
+    lg, _ = local_graph_from_stacked(
+        jax.tree.map(lambda x: jnp.asarray(x)
+                     if hasattr(x, "dtype") else x, graph), None)
+    data = jnp.asarray(
+        rng.standard_normal((graph.e_cap, 4)).astype(np.float32))
+    mask = lg.edge_mask
+    got = np.asarray(lg.aggregate_edges(data, mask))
+    want = np.asarray(jax.ops.segment_sum(
+        jnp.where(mask[:, None], data, 0.0), lg.edge_dst,
+        num_segments=lg.n_cap))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_chunk_sorted_hint(rng):
+    cart, nl, plan, graph, host = _graph(_system(rng), 2)
+    lg, _ = local_graph_from_stacked(graph, None)
+    assert lg.has_frontier_split
+    assert lg.chunk_sorted(lg.e_split)      # boundary-aligned chunks
+    assert not lg.chunk_sorted(lg.e_split - 1) or lg.e_split % (
+        lg.e_split - 1) == 0
+    assert lg.chunk_sorted(0)               # chunking disabled
+    lg.e_split = lg.e_cap                   # unsplit view
+    assert lg.chunk_sorted(7)
+
+
+# ---------------------------------------------------------------------------
+# fused site readout
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_fused_magmom_parity_vs_site_fn(rng, params):
+    """DistPotential's fused aux magmoms == the legacy make_site_fn
+    readout, across partitionings."""
+    from distmlip_tpu.calculators import Atoms, DistPotential
+
+    cart, lattice, species = make_crystal(rng, reps=(4, 2, 2), a=A_LAT)
+    atoms = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+    smap = np.concatenate([[0], np.arange(0, 8)]).astype(np.int32)
+    outs = {}
+    for key, kw in (("fused", dict(fused_site_readout=True)),
+                    ("legacy", dict(fused_site_readout=False))):
+        pot = DistPotential(MODEL, params, num_partitions=2,
+                            species_map=smap, compute_magmom=True, **kw)
+        assert pot.fused_site_readout == (key == "fused")
+        outs[key] = pot.calculate(atoms)
+        if key == "fused":
+            assert pot._site_fn is None  # no separate readout program
+    np.testing.assert_allclose(outs["fused"]["magmoms"],
+                               outs["legacy"]["magmoms"], atol=1e-5)
+    assert abs(outs["fused"]["energy"] - outs["legacy"]["energy"]) < 1e-5

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Audit collective counts of the graph-parallel potential programs.
+
+    python tools/halo_audit.py [--model chgnet|pair|tensornet]
+        [--nparts 2] [--reps 4,2,2] [--per-scope] [--json]
+
+Builds a small test system, traces the jitted potential under BOTH halo
+modes (plus the fused-aux and legacy site-readout programs when the model
+has a sitewise head), and prints collective counts straight from the
+jaxprs — the chip-free view of what the overlap-aware halo pipeline
+(ISSUE 2) saves per MD step. ``--per-scope`` additionally groups ppermutes
+by ``jax.named_scope`` name stack so the per-layer structure is visible.
+
+Exit codes: 0 ok, 2 usage.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# multi-device CPU mesh, set before jax initializes (same trick as tests)
+_flag = "--xla_force_host_platform_device_count=8"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+
+def build_system(reps, model_name):
+    import numpy as np
+
+    from distmlip_tpu import geometry
+
+    rng = np.random.default_rng(0)
+    a = 3.5
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, 0.03, (len(frac), 3))
+    species = rng.integers(0, 2, len(frac)).astype(np.int32)
+    return cart, lattice, species
+
+
+def make_model(name):
+    import jax
+
+    if name == "chgnet":
+        from distmlip_tpu.models.chgnet import CHGNet, CHGNetConfig
+
+        model = CHGNet(CHGNetConfig(
+            num_species=4, units=16, num_rbf=6, num_blocks=3,
+            cutoff=3.2, bond_cutoff=2.6))
+        use_bg, bond_r = True, 2.6
+    elif name == "tensornet":
+        from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+
+        model = TensorNet(TensorNetConfig(
+            num_species=4, units=16, num_rbf=8, cutoff=3.2))
+        use_bg, bond_r = False, 0.0
+    elif name == "pair":
+        from distmlip_tpu.models.pair import PairConfig, PairPotential
+
+        model = PairPotential(PairConfig(cutoff=3.2))
+        use_bg, bond_r = False, 0.0
+    else:
+        raise SystemExit(f"unknown --model {name!r}")
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params, use_bg, bond_r
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="halo_audit", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--model", default="chgnet",
+                    choices=("chgnet", "pair", "tensornet"))
+    ap.add_argument("--nparts", type=int, default=2)
+    ap.add_argument("--reps", default=None,
+                    help="supercell reps gx,gy,gz (default: 2*nparts,2,2 so "
+                         "slabs stay wider than the cutoff)")
+    ap.add_argument("--per-scope", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+        if args.reps is None:
+            reps = (max(2 * args.nparts, 4), 2, 2)
+        else:
+            reps = tuple(int(x) for x in args.reps.split(","))
+        if len(reps) != 3:
+            raise ValueError("--reps wants gx,gy,gz")
+    except (SystemExit, ValueError) as e:
+        if isinstance(e, SystemExit) and e.code in (0, None):
+            return 0
+        print(f"usage error: {e}", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distmlip_tpu.neighbors import neighbor_list_numpy
+    from distmlip_tpu.parallel import (graph_mesh, make_potential_fn,
+                                       make_site_fn)
+    from distmlip_tpu.parallel.audit import (count_collectives,
+                                             ppermutes_by_scope)
+    from distmlip_tpu.partition import build_partitioned_graph, build_plan
+
+    model, params, use_bg, bond_r = make_model(args.model)
+    cart, lattice, species = build_system(reps, args.model)
+    r = model.cfg.cutoff
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], r, bond_r=bond_r)
+    plan = build_plan(nl, lattice, [1, 1, 1], args.nparts, r, bond_r, use_bg)
+    graph, _host = build_partitioned_graph(plan, nl, species, lattice)
+    mesh = graph_mesh(args.nparts) if args.nparts > 1 else None
+
+    programs = {}
+    for mode in ("coalesced", "legacy"):
+        programs[f"potential[{mode}]"] = make_potential_fn(
+            model.energy_fn, mesh, halo_mode=mode)
+    if hasattr(model, "energy_and_aux_fn"):
+        programs["potential+aux[coalesced]"] = make_potential_fn(
+            model.energy_and_aux_fn, mesh, halo_mode="coalesced", aux=True)
+    if hasattr(model, "magmom_fn"):
+        programs["site_fn[legacy]"] = make_site_fn(
+            model.magmom_fn, mesh, halo_mode="legacy")
+
+    report = {"model": args.model, "nparts": args.nparts,
+              "n_atoms": len(cart), "e_split": graph.e_split,
+              "e_cap": graph.e_cap, "programs": {}}
+    for name, fn in programs.items():
+        jaxpr = jax.make_jaxpr(fn)(params, graph, graph.positions)
+        counts = count_collectives(jaxpr)
+        entry = {"total": sum(counts.values()), **dict(counts)}
+        if args.per_scope:
+            entry["ppermutes_by_scope"] = dict(ppermutes_by_scope(jaxpr))
+        report["programs"][name] = entry
+
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    print(f"halo audit: model={args.model} P={args.nparts} "
+          f"atoms={report['n_atoms']} e_split={graph.e_split}/{graph.e_cap}")
+    for name, entry in report["programs"].items():
+        parts = " ".join(f"{k}={v}" for k, v in entry.items()
+                         if k not in ("total", "ppermutes_by_scope"))
+        print(f"  {name:<28} total={entry['total']:<4} {parts}")
+        for scope, n in entry.get("ppermutes_by_scope", {}).items():
+            print(f"      {n:3d}x {scope}")
+    pot_c = report["programs"].get("potential[coalesced]", {}).get("total", 0)
+    pot_l = report["programs"].get("potential[legacy]", {}).get("total", 0)
+    if pot_c and pot_l:
+        print(f"  coalesced/legacy collective ratio: {pot_c / pot_l:.2f}x")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -136,12 +136,11 @@ def main():
     print(json.dumps({"probe": "gather_full_edges", "ms": round(ms, 1)}),
           flush=True)
 
-    # sorted segment sum at full edge count, Q=40 (channels-last)
-    from distmlip_tpu.ops.segment import masked_segment_sum
+    # sorted segment sum at full edge count, Q=40 (channels-last);
+    # aggregate_edges = per-segment sorted sums under the frontier split
     M = jnp.asarray(rng.standard_normal((e_cap, 40, C)), dtype=dtype)
-    seg = jax.jit(partial(masked_segment_sum, num_segments=n_cap,
-                          indices_are_sorted=True))
-    ms = bench_fn(lambda m: seg(m, lg.edge_dst, mask=lg.edge_mask), M)
+    seg = jax.jit(lambda m: lg.aggregate_edges(m, lg.edge_mask))
+    ms = bench_fn(seg, M)
     print(json.dumps({"probe": "segment_sum_full_edges_Q40", "ms": round(ms, 1)}),
           flush=True)
 

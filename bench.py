@@ -28,7 +28,7 @@ _METRIC = "mace_mp0_md_step_atoms_per_sec_per_chip"
 _TELEMETRY = {
     "probe_attempts": 0,     # canary launches this run
     "wedge_suspected": False,  # a canary neither exited nor failed in budget
-    "canary": "not_run",     # not_run | ok | unavailable | left_running
+    "canary": "not_run",     # not_run | ok | unavailable | killed
 }
 
 
@@ -143,19 +143,50 @@ _CANARY_SRC = None
 _CANARY_LOG = os.environ.get("BENCH_CANARY_LOG", "/tmp/bench_canary.log")
 
 
+def _kill_canary_group(proc):
+    """TERM -> grace -> KILL the canary's whole process group.
+
+    The canary runs in its own session (start_new_session=True), so its
+    pgid == its pid and any children it spawned die with it. Escalates to
+    SIGKILL after BENCH_CANARY_KILL_GRACE_S (default 10 s) and always
+    reaps the subprocess handle so no zombie outlives the bench."""
+    import signal
+
+    grace = float(os.environ.get("BENCH_CANARY_KILL_GRACE_S", "10"))
+    try:
+        pgid = os.getpgid(proc.pid)
+    except (ProcessLookupError, PermissionError):
+        proc.poll()
+        return
+    for sig, wait_s in ((signal.SIGTERM, grace), (signal.SIGKILL, 5.0)):
+        try:
+            os.killpg(pgid, sig)
+        except (ProcessLookupError, PermissionError):
+            break
+        try:
+            proc.wait(timeout=wait_s)
+            break
+        except subprocess.TimeoutExpired:
+            continue
+    proc.poll()  # reap
+
+
 def _canary_claim(watchdog):
     """Probe the chip grant with a DISPOSABLE subprocess before claiming.
 
     Round-4 lesson (VERDICT r4 weak #1): `jax.devices()` on a wedged axon
-    grant HANGS, and a process that dies mid-claim — including this bench
+    grant HANGS, and the PARENT dying mid-claim — e.g. this bench
     os._exit'ing under its own watchdog — renews the server-side lease
     wedge. So the risky first claim happens in a canary subprocess: if it
     exits 0 the grant is healthy and the parent claims in-process; if it
     raises we retry/fail structured; if it neither exits nor fails within
-    the budget the canary is LEFT RUNNING (started in its own session, log
-    at BENCH_CANARY_LOG) — it holds its pending claim harmlessly until the
-    lease clears, at which point it writes /tmp/tpu_up and exits — and the
-    parent reports wedge_suspected=true without ever touching the backend.
+    the budget the grant is wedged and the canary is KILLED (process-group
+    TERM -> grace -> KILL, reported as ``canary: killed``). Round-6 lesson
+    (BENCH_r05): the earlier leave-it-running policy leaked the pid
+    (``canary: left_running``) — the orphan held its pending claim long
+    after the round ended, serializing against the NEXT round's probe.
+    Killing the disposable canary is safe precisely because the parent
+    never started a claim of its own.
 
     Returns (ok: bool, detail: str). Never raises.
     """
@@ -188,13 +219,16 @@ def _canary_claim(watchdog):
         _TELEMETRY["canary_elapsed_s"] = round(elapsed, 1)
         rc = proc.poll()
         if rc is None:
-            # Budget exhausted, canary still mid-claim: LEAVE IT RUNNING.
-            _TELEMETRY["canary"] = "left_running"
+            # Budget exhausted, canary still mid-claim: the grant is
+            # wedged. Kill the disposable canary's process group instead
+            # of leaking it (BENCH_r05's `canary: left_running` pid).
+            _kill_canary_group(proc)
+            _TELEMETRY["canary"] = "killed"
             _TELEMETRY["wedge_suspected"] = True
             _TELEMETRY["canary_pid"] = proc.pid
             return False, (
                 f"canary claim still pending after {elapsed:.0f}s "
-                f"(chip grant wedged; canary pid {proc.pid} left running, "
+                f"(chip grant wedged; canary pid {proc.pid} killed, "
                 f"log {_CANARY_LOG})")
         if rc == 0:
             _TELEMETRY["canary"] = "ok"
@@ -374,6 +408,51 @@ def _main_measured():
         t0 = time.perf_counter()
         pot.calculate(atoms)
         watchdog.times.append(time.perf_counter() - t0)
+
+    # batched-engine throughput (serving regime): structures/sec at batch
+    # sizes {1, 8} over small structures through ONE BatchedPotential (its
+    # shape-bucketed compile cache covers both batch sizes). Every batched
+    # step emits a StepRecord carrying structures_per_sec/bucket_key to the
+    # same telemetry sinks (JSONL artifact included). BENCH_BATCHED=0 skips.
+    batched_extras = {}
+    if os.environ.get("BENCH_BATCHED", "1") != "0":
+        b_budget = float(os.environ.get("BENCH_BATCHED_TIMEOUT_S", "600"))
+        watchdog.phase(
+            f"batched throughput measurement exceeded {b_budget:.0f}s",
+            b_budget)
+        try:
+            from distmlip_tpu.calculators import BatchedPotential
+            from distmlip_tpu.partition import BucketPolicy
+
+            b_reps = int(os.environ.get("BENCH_BATCHED_REPS", "2"))
+            b_steps = int(os.environ.get("BENCH_BATCHED_STEPS", "3"))
+            frac_b, lat_b = geometry.make_supercell(
+                unit, np.eye(3) * 3.9, (b_reps, b_reps, b_reps))
+            # pot.model carries the bench compute dtype (bf16 by default)
+            bpot = BatchedPotential(
+                pot.model, pot.params, caps=BucketPolicy(),
+                skin=float(os.environ.get("BENCH_SKIN", "0.5")),
+                telemetry=telemetry)
+            for B in (1, 8):
+                structs = []
+                for _ in range(B):
+                    cart_b = geometry.frac_to_cart(frac_b, lat_b) + \
+                        rng.normal(0, 0.04, (len(frac_b), 3))
+                    structs.append(Atoms(numbers=np.full(len(cart_b), 14),
+                                         positions=cart_b, cell=lat_b))
+                bpot.calculate(structs)  # compile + first pack
+                t0 = time.perf_counter()
+                for _ in range(b_steps):
+                    for a in structs:
+                        a.positions += rng.normal(
+                            0, 0.01, a.positions.shape)
+                    bpot.calculate(structs)
+                dt_b = (time.perf_counter() - t0) / max(b_steps, 1)
+                batched_extras[f"structures_per_sec_b{B}"] = round(
+                    B / dt_b, 2)
+            batched_extras["batched_compiles"] = bpot.compile_count
+        except Exception as e:  # noqa: BLE001 - batched is additive
+            batched_extras["batched_error"] = f"{type(e).__name__}: {e}"[:160]
     watchdog.finish()  # from here on the watchdog cannot print
     dt = float(np.median(watchdog.times))
     atoms_per_sec = len(atoms) / dt / max(len(jax.devices()), 1)
@@ -381,7 +460,7 @@ def _main_measured():
     # overlap-pipeline accounting: collective count of the measured mode AND
     # its A/B counterpart (host-side jaxpr traces — no device work), plus
     # the analytic-FLOP mfu for the measured steps
-    extras = {"halo_mode": halo_mode}
+    extras = {"halo_mode": halo_mode, **batched_extras}
     try:
         from distmlip_tpu.parallel import make_potential_fn
         from distmlip_tpu.parallel.audit import count_collectives

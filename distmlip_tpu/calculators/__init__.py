@@ -5,6 +5,7 @@ from .calculator import (DistPotential, EnsemblePotential, UMAPredictor,
 from .md import MolecularDynamics, TrajectoryObserver, ENSEMBLES
 from .device_md import DeviceMD
 from .relax import Relaxer, RelaxResult
+from .batched import BatchedMD, BatchedPotential, BatchedRelaxer
 
 __all__ = [
     "Atoms", "KB", "AMU_A2_FS2_TO_EV", "EV_A3_TO_GPA",
@@ -12,4 +13,5 @@ __all__ = [
     "DistPotential", "EnsemblePotential", "UMAPredictor", "make_ase_calculator",
     "MolecularDynamics", "TrajectoryObserver", "ENSEMBLES", "DeviceMD",
     "Relaxer", "RelaxResult",
+    "BatchedPotential", "BatchedRelaxer", "BatchedMD",
 ]

@@ -22,6 +22,26 @@ AMU_A2_FS2_TO_EV = 103.642696562
 EV_A3_TO_GPA = 160.21766208
 
 
+def map_species(numbers: np.ndarray, species_map: np.ndarray | None) -> np.ndarray:
+    """Atomic numbers -> model species indices (identity when no map) —
+    the one species-mapping rule shared by DistPotential and
+    BatchedPotential."""
+    if species_map is None:
+        return np.asarray(numbers, dtype=np.int32)
+    return np.asarray(species_map)[numbers].astype(np.int32)
+
+
+def max_displacement(positions: np.ndarray, build_positions: np.ndarray) -> float:
+    """Largest per-atom displacement (Å) from the build-time positions —
+    the Verlet skin criterion's primitive (a cached graph stays valid
+    while this is < skin/2), shared by the single-structure and batched
+    graph caches."""
+    if len(positions) == 0:
+        return 0.0
+    disp = positions - build_positions
+    return float(np.sqrt(np.max(np.sum(disp * disp, axis=1))))
+
+
 class Atoms:
     def __init__(self, numbers=None, symbols=None, positions=None, cell=None,
                  pbc=(True, True, True), velocities=None, masses=None,

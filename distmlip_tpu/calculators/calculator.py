@@ -41,7 +41,7 @@ from ..neighbors import neighbor_list
 from ..parallel import graph_mesh, make_potential_fn, make_site_fn
 from ..partition import CapacityPolicy, build_partitioned_graph, build_plan
 from ..telemetry import StepRecord, annotate
-from .atoms import EV_A3_TO_GPA, Atoms
+from .atoms import EV_A3_TO_GPA, Atoms, map_species, max_displacement
 
 
 def _device_memory_stats() -> dict:
@@ -306,9 +306,7 @@ class DistPotential:
         return max(1, min(len(self._devices), p_geom))
 
     def _species(self, numbers: np.ndarray) -> np.ndarray:
-        if self.species_map is None:
-            return numbers.astype(np.int32)
-        return self.species_map[numbers].astype(np.int32)
+        return map_species(numbers, self.species_map)
 
     @staticmethod
     def _system(atoms: Atoms) -> dict:
@@ -402,8 +400,7 @@ class DistPotential:
     def _disp_frac(self, build_pos, positions) -> float:
         """Max displacement from build positions as a fraction of the skin/2
         Verlet budget (>= 1.0: the build is no longer valid)."""
-        disp = positions - build_pos
-        d = float(np.sqrt(np.max(np.sum(disp * disp, axis=1))))
+        d = max_displacement(positions, build_pos)
         return d / (0.5 * self.skin) if self.skin > 0.0 else np.inf
 
     def _cache_valid(self, atoms: Atoms) -> bool:

@@ -321,7 +321,29 @@ class ESCN:
         # softmax gate. Globally consistent across partitions (psum'd mean),
         # replicated — the TPU version of the reference's replicated MOLE
         # coefficients with its csd-driven gating (escn_md.py:255-265,343-357)
-        if cfg.num_experts > 1:
+        #
+        # On a BATCHED (block-diagonally packed) graph the composition is a
+        # per-STRUCTURE quantity: pooling over the whole packed array would
+        # leak one structure's composition into another's gate — the one
+        # place this architecture is not automatically block-diagonal. The
+        # batched branch therefore segment-means per struct_id and mixes
+        # experts per edge (K small GEMMs) instead of once in weight space.
+        batched_gate = (cfg.num_experts > 1
+                        and lg.struct_id is not None and lg.batch_size > 0)
+        if batched_gate:
+            owned = lg.owned_mask.astype(dtype)[:, None]
+            B = lg.batch_size
+            comp_sum = jax.ops.segment_sum(
+                zemb * owned, lg.struct_id, num_segments=B,
+                indices_are_sorted=True)                       # (B, C)
+            count = jax.ops.segment_sum(
+                owned[:, 0], lg.struct_id, num_segments=B,
+                indices_are_sorted=True)                       # (B,)
+            gate_in = jnp.concatenate(
+                [comp_sum / jnp.maximum(count, 1.0)[:, None],
+                 jnp.broadcast_to(csd, (B,) + csd.shape)], axis=-1)
+            mole = jax.nn.softmax(mlp(params["mole_gate"], gate_in), axis=-1)
+        elif cfg.num_experts > 1:
             owned = lg.owned_mask.astype(dtype)[:, None]
             comp_sum = lg.psum(jnp.sum(zemb * owned, axis=0))
             count = lg.psum(jnp.sum(owned))
@@ -331,6 +353,18 @@ class ESCN:
             mole = jax.nn.softmax(mlp(params["mole_gate"], gate_in))
         else:
             mole = jnp.ones((1,), dtype=dtype)
+
+        if batched_gate:
+            def so2_apply(f, Wk, mole_e):
+                # per-edge expert mixture: evaluate the K expert GEMMs and
+                # combine with the edge's structure gate — equivalent to
+                # f @ (sum_k mole[s(e), k] W_k) without materializing a
+                # per-edge weight matrix
+                yk = jnp.einsum("ea,kab->ekb", f, Wk.astype(f.dtype))
+                return jnp.einsum("ekb,ek->eb", yk, mole_e.astype(f.dtype))
+        else:
+            def so2_apply(f, Wk, mole_e):
+                return f @ jnp.einsum("k,kab->ab", mole, Wk)
 
         inv_avg = jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)
         for layer in params["layers"]:
@@ -346,6 +380,9 @@ class ESCN:
                 # inject edge scalars into the l=0 channel
                 h_rot = h_rot.at[:, 0, :].add(g_e)
 
+                # per-edge structure gate (dst rows are always real atoms)
+                mole_e = mole[lg.struct_id[dstc]] if batched_gate else None
+
                 # SO(2) convolutions per |m|; the per-m feature vector
                 # flattens (nl, C) row-major — the (d, d) weight basis
                 # follows this order
@@ -354,16 +391,19 @@ class ESCN:
                     plus, minus = self.m_idx[m]
                     nl = len(plus)
                     if m == 0:
-                        W = jnp.einsum("k,kab->ab", mole, layer["so2"]["m0"])
                         f = h_rot[:, plus, :].reshape(-1, nl * C)
-                        y = y.at[:, plus, :].set((f @ W).reshape(-1, nl, C))
+                        y = y.at[:, plus, :].set(
+                            so2_apply(f, layer["so2"]["m0"],
+                                      mole_e).reshape(-1, nl, C))
                     else:
-                        Wr = jnp.einsum("k,kab->ab", mole, layer["so2"][f"m{m}r"])
-                        Wi = jnp.einsum("k,kab->ab", mole, layer["so2"][f"m{m}i"])
+                        Wr = layer["so2"][f"m{m}r"]
+                        Wi = layer["so2"][f"m{m}i"]
                         fp = h_rot[:, plus, :].reshape(-1, nl * C)
                         fm = h_rot[:, minus, :].reshape(-1, nl * C)
-                        yp = fp @ Wr - fm @ Wi
-                        ym = fp @ Wi + fm @ Wr
+                        yp = so2_apply(fp, Wr, mole_e) - so2_apply(
+                            fm, Wi, mole_e)
+                        ym = so2_apply(fp, Wi, mole_e) + so2_apply(
+                            fm, Wr, mole_e)
                         y = y.at[:, plus, :].set(yp.reshape(-1, nl, C))
                         y = y.at[:, minus, :].set(ym.reshape(-1, nl, C))
 

@@ -362,6 +362,16 @@ class ESCNMD:
 
         # MOLE coefficients: psum-consistent composition + csd gate
         if cfg.num_experts > 1:
+            if lg.struct_id is not None and lg.batch_size > 0:
+                # the composition pool below spans the WHOLE graph — on a
+                # packed batch that would silently mix structures' gates.
+                # The base models/escn.py ESCN implements per-structure
+                # gating; this UMA-MD variant does not (yet).
+                raise NotImplementedError(
+                    "ESCNMD's MOLE gate pools composition per system; "
+                    "batched (packed) graphs would mix structures. Use "
+                    "models.escn.ESCN for batched inference, or "
+                    "num_experts=1.")
             owned = lg.owned_mask.astype(dtype)[:, None]
             comp = lg.psum(jnp.sum(zemb * owned, axis=0))
             count = lg.psum(jnp.sum(owned))

@@ -2,7 +2,8 @@ from .mesh import (GRAPH_AXIS, ensure_latency_hiding_flags, graph_mesh,
                    latency_hiding_flags)
 from .halo import HALO_MODES, LocalGraph, local_graph_from_stacked
 from .runtime import (make_total_energy, make_potential_fn,
-                      make_site_fn, graph_in_specs)
+                      make_batched_potential_fn, make_site_fn,
+                      graph_in_specs)
 from .audit import collective_counts, count_collectives, ppermutes_by_scope
 
 __all__ = [
@@ -15,6 +16,7 @@ __all__ = [
     "local_graph_from_stacked",
     "make_total_energy",
     "make_potential_fn",
+    "make_batched_potential_fn",
     "make_site_fn",
     "graph_in_specs",
     "collective_counts",

@@ -179,6 +179,12 @@ class LocalGraph:
     # == e_cap means unsplit
     e_split: int = -1
     halo_mode: str = "coalesced"
+    # batched multi-structure packing (PartitionedGraph.batch_size /
+    # struct_id); 0 = unbatched. Models never need these — the per-
+    # structure readout lives in the batched runtime — but they ride the
+    # LocalGraph so the runtime sees them inside the traced function.
+    batch_size: int = 0
+    struct_id: Any = None
 
     @property
     def has_frontier_split(self) -> bool:
@@ -405,5 +411,7 @@ def local_graph_from_stacked(
         bond_halo_send_mask=g.bond_halo_send_mask[:, 0],
         bond_halo_recv_idx=g.bond_halo_recv_idx[:, 0],
         system=g.system,
+        batch_size=g.batch_size,
+        struct_id=sq(g.struct_id),
     )
     return lg, sq(g.positions)

@@ -69,6 +69,7 @@ def graph_in_specs(graph: PartitionedGraph) -> PartitionedGraph:
         bond_map_edge=row, bond_map_bond=row, bond_map_mask=row,
         bond_halo_send_idx=table, bond_halo_send_mask=table,
         bond_halo_recv_idx=table,
+        struct_id=None if graph.struct_id is None else row,
     )
 
 
@@ -216,6 +217,96 @@ def make_potential_fn(model_energy_fn, mesh: Mesh | None,
             g_pos = grads
             stress = jnp.zeros((3, 3), dtype=positions.dtype)
         out = {"energy": energy, "forces": -g_pos, "stress": stress}
+        if aux:
+            out["aux"] = aux_out
+        return out
+
+    return potential
+
+
+def make_batched_potential_fn(model_energy_fn, compute_stress: bool = True,
+                              aux: bool = False):
+    """Jitted batched potential over a block-diagonally packed graph.
+
+    ``(params, graph, positions) -> dict`` where ``graph`` is a
+    single-partition ``PartitionedGraph`` built by
+    :func:`distmlip_tpu.partition.pack_structures` (``batch_size`` B slots,
+    ``struct_id`` per node, Cartesian edge offsets, identity lattice):
+
+    - ``energies``: (B,) per-structure energies — ONE
+      ``segment_sum(e_atoms, struct_id)`` readout over the model's per-atom
+      energies (padded rows carry ``struct_id == B`` and are dropped);
+      empty slots read 0.
+    - ``forces``: (P=1, N_cap, 3) packed per-atom forces from ONE
+      ``value_and_grad`` through the whole super-graph. The blocks share no
+      edges, so d(sum_b E_b)/dx_i = dE_{struct(i)}/dx_i exactly — batching
+      introduces no cross-terms.
+    - ``strain_grad``: (B, 3, 3) dE_b/d(strain_b) — each structure gets its
+      OWN symmetric strain applied to its positions and (Cartesian) edge
+      offsets; divide by per-structure volume on the host for stress.
+    - ``aux`` (``aux=True``): the model's fused per-atom outputs (packed
+      layout, slice per structure on the host).
+
+    The batched path is deliberately single-partition (``mesh=None``): its
+    regime is MANY SMALL structures per device step (the TorchSim batching
+    regime, arXiv:2508.06628), which composes with — rather than replaces —
+    the halo-partitioned path for one large structure. No collectives are
+    traced, so collective counts are independent of B (tools/halo_audit.py
+    ``--batch`` asserts this).
+    """
+
+    def batched_energy(params, strain, graph, positions):
+        lg, _ = local_graph_from_stacked(graph, None, "coalesced")
+        B = graph.batch_size
+        dtype = positions.dtype
+        pos = positions[0]
+        sid = lg.struct_id
+        with scope("apply_strain"):
+            # per-structure symmetric strain: x_i -> x_i @ (I + eps_{s(i)});
+            # Cartesian edge offsets deform with their structure's cell.
+            # Padded node rows have sid == B — the gather clamps them onto
+            # the last real slot, which is harmless (their rows are masked).
+            sym = 0.5 * (strain + jnp.swapaxes(strain, -1, -2)).astype(dtype)
+            defm = jnp.eye(3, dtype=dtype)[None, :, :] + sym      # (B, 3, 3)
+            pos = jnp.einsum("ni,nij->nj", pos, defm[sid])
+            esid = sid[lg.edge_dst]  # edge's structure (dst rows are real)
+            lg.edge_offset = jnp.einsum(
+                "ei,eij->ej", lg.edge_offset.astype(dtype), defm[esid])
+        with scope("model_energy"):
+            out = model_energy_fn(params, lg, pos)
+        e_atoms, aux_out = out if aux else (out, None)
+        with scope("batched_readout"):
+            e = jnp.where(lg.owned_mask,
+                          e_atoms.reshape(-1).astype(dtype), 0)
+            # padded rows carry sid == B (out of range -> dropped); real
+            # rows are contiguous per structure, so indices are sorted
+            energies = jax.ops.segment_sum(
+                e, sid, num_segments=B, indices_are_sorted=True)
+        return jnp.sum(energies), (energies, aux_out)
+
+    @jax.jit
+    def potential(params, graph, positions):
+        if graph.num_partitions != 1 or graph.batch_size < 1:
+            raise ValueError(
+                "make_batched_potential_fn requires a single-partition "
+                f"packed graph (got P={graph.num_partitions}, "
+                f"batch_size={graph.batch_size}); build it with "
+                "pack_structures().")
+        B = graph.batch_size
+        strain = jnp.zeros((B, 3, 3), dtype=positions.dtype)
+        grad_fn = jax.value_and_grad(
+            batched_energy, argnums=(3, 1) if compute_stress else 3,
+            has_aux=True)
+        with scope("energy_and_grad"):
+            (_, (energies, aux_out)), grads = grad_fn(
+                params, strain, graph, positions)
+        if compute_stress:
+            g_pos, g_strain = grads
+        else:
+            g_pos = grads
+            g_strain = jnp.zeros((B, 3, 3), dtype=positions.dtype)
+        out = {"energies": energies, "forces": -g_pos,
+               "strain_grad": g_strain}
         if aux:
             out["aux"] = aux_out
         return out

@@ -1,7 +1,9 @@
 from .plan import PartitionPlan
 from .partitioner import build_block_plan, build_plan, PartitionError
 from .graph import PartitionedGraph, HostGraphData, build_partitioned_graph
-from .capacity import CapacityPolicy, round_capacity
+from .capacity import (BucketPolicy, CapacityPolicy, geometric_bucket,
+                       round_capacity)
+from .batch import PackedHostData, bucket_key, pack_structures, packed_stats
 
 __all__ = [
     "PartitionPlan",
@@ -12,5 +14,11 @@ __all__ = [
     "HostGraphData",
     "build_partitioned_graph",
     "CapacityPolicy",
+    "BucketPolicy",
+    "geometric_bucket",
     "round_capacity",
+    "PackedHostData",
+    "pack_structures",
+    "packed_stats",
+    "bucket_key",
 ]

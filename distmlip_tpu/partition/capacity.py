@@ -4,10 +4,25 @@ Edge/halo counts change every MD step; XLA programs need static shapes. We
 round every capacity up to a bucket so a recompile only happens when a count
 outgrows its bucket (the reference never faced this — eager PyTorch —
 see SURVEY.md §7 "Hard parts").
+
+Two policies coexist:
+
+- ``CapacityPolicy`` (sticky): caps only grow, per process. Right for a
+  long MD/relax run of ONE system, where sizes drift slowly and the cap
+  converges after a few steps.
+- ``BucketPolicy`` (geometric, stateless): every request maps to the
+  nearest bucket of a fixed geometric ladder (``growth`` steps, default
+  ~sqrt(2) — the MACE data-distribution study's padding/recompile
+  trade-off, arXiv:2504.10700). Right for a SERVING stream of many
+  different systems: a request's shapes depend only on its own sizes, so
+  any stream drawn from a bounded size range hits at most
+  ``ceil(log_growth(spread))`` distinct shapes per dimension — a small,
+  fixed executable set — instead of one compile per novel size.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 
 
@@ -17,6 +32,30 @@ def round_capacity(n: int, slack: float = 1.2, multiple: int = 128) -> int:
         return multiple
     target = int(n * slack) + 1
     return ((target + multiple - 1) // multiple) * multiple
+
+
+def geometric_bucket(n: int, base: int = 128, growth: float = 2.0 ** 0.5,
+                     multiple: int = 128) -> int:
+    """Smallest ladder rung ``base * growth**k`` (k >= 0) holding ``n``,
+    rounded up to ``multiple`` (TPU lane width).
+
+    Lane rounding may collapse adjacent rungs onto the same value (which
+    only shrinks the bucket set), so the number of distinct buckets over a
+    size range [lo, hi] is bounded by
+    ``ceil(log_growth(hi / max(lo, base)))`` + 1 regardless of how many
+    distinct sizes the stream contains.
+    """
+    if growth <= 1.0:
+        raise ValueError(f"growth must be > 1, got {growth}")
+    if n <= base:
+        rung = base
+    else:
+        k = math.ceil(math.log(n / base) / math.log(growth) - 1e-9)
+        rung = base * growth ** k
+        # float rounding may land one rung short for exact powers
+        if rung < n - 1e-6:
+            rung = base * growth ** (k + 1)
+    return ((int(math.ceil(rung)) + multiple - 1) // multiple) * multiple
 
 
 class CapacityPolicy:
@@ -42,3 +81,31 @@ class CapacityPolicy:
                           cap)
                 self._caps[name] = cap
             return cap
+
+
+class BucketPolicy:
+    """Stateless geometric capacity ladder (see module docstring).
+
+    Unlike ``CapacityPolicy``, ``get`` is a pure function of ``needed`` —
+    no history — so identical request sizes always produce identical
+    shapes, and a bounded size range produces a bounded shape set. Small
+    dimensions (batch slots) use ``base=1, multiple=1`` via
+    :meth:`get_small` so a 3-structure batch doesn't pad to 128 slots.
+    """
+
+    def __init__(self, base: int = 128, growth: float = 2.0 ** 0.5,
+                 multiple: int = 128):
+        if growth <= 1.0:
+            raise ValueError(f"growth must be > 1, got {growth}")
+        self.base = int(base)
+        self.growth = float(growth)
+        self.multiple = int(multiple)
+
+    def get(self, name: str, needed: int) -> int:
+        return geometric_bucket(needed, self.base, self.growth, self.multiple)
+
+    def get_small(self, needed: int) -> int:
+        """Bucket for small count dimensions (e.g. batch size): next power
+        of two, no lane-width rounding."""
+        n = max(int(needed), 1)
+        return 1 << (n - 1).bit_length()

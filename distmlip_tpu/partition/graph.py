@@ -50,9 +50,10 @@ _default_caps = CapacityPolicy()
         "bond_halo_recv_idx",
         "n_total_nodes",
         "system",
+        "struct_id",
     ],
     meta_fields=["num_partitions", "shifts", "has_bond_graph", "n_cap",
-                 "e_cap", "b_cap", "e_split"],
+                 "e_cap", "b_cap", "e_split", "batch_size"],
 )
 @dataclass
 class PartitionedGraph:
@@ -102,6 +103,14 @@ class PartitionedGraph:
     # per-system replicated scalars (UMA charge/spin/dataset conditioning,
     # reference uma/escn_md.py:255-265)
     system: Any = None      # {"charge","spin","dataset"}: () int32 each
+    # --- batched multi-structure packing (partition/batch.py) ---
+    # batch_size: number of structure SLOTS packed block-diagonally into
+    # this graph (0 = unbatched single-structure graph). struct_id maps
+    # each node row to its structure slot; padded node rows point at
+    # batch_size (one past the last slot) so the per-structure
+    # segment_sum readout drops them.
+    batch_size: int = 0
+    struct_id: Any = None   # (P, N_cap) int32 when batch_size > 0
 
 
 @dataclass

@@ -57,6 +57,12 @@ class StepRecord:
     # partition) — the non-overlappable tail of the interior/frontier split
     frontier_edge_frac: float = 0.0
 
+    # --- batched multi-structure engine (calculators/batched.py) ---
+    batch_size: int = 0              # real structures this step (0: unbatched)
+    bucket_key: str = ""             # compiled-shape bucket id (n/e/B caps)
+    padding_waste_frac: float = 0.0  # dead padded slots / total slots
+    structures_per_sec: float = 0.0  # batch throughput (batch_size / total_s)
+
     # --- halo pipeline + device-program cost model ---
     halo_mode: str = ""              # coalesced | legacy ("" = unknown)
     collective_count: int = 0        # collectives in the traced step program

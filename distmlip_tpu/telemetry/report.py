@@ -87,6 +87,20 @@ class Report:
             out.append("halo pipeline: " + " ".join(bits))
         if "mean_mfu" in c:
             out.append(f"mfu: mean={c['mean_mfu']:.3f} max={c['max_mfu']:.3f}")
+        if c.get("buckets"):
+            out.append("")
+            out.append("batched buckets (shape-bucketed compile cache):")
+            out.append(
+                "bucket                        steps  mean_B  node_occ"
+                "  edge_occ  waste  structs/s")
+            for key in sorted(c["buckets"]):
+                b = c["buckets"][key]
+                out.append(
+                    f"{key:<28} {b['steps']:6d} {b['mean_batch_size']:7.1f} "
+                    f"{b['mean_node_occupancy']:9.2f} "
+                    f"{b['mean_edge_occupancy']:9.2f} "
+                    f"{b['mean_padding_waste_frac']:6.2f} "
+                    f"{b['mean_structures_per_sec']:10.1f}")
         if c.get("prefetch_skipped_hbm"):
             out.append(f"prefetch skipped by HBM guard: "
                        f"{c['prefetch_skipped_hbm']} step(s)")
@@ -159,6 +173,31 @@ def aggregate(
     c["prefetch_skipped_hbm"] = sum(
         getattr(r, "prefetch_skipped_hbm", False) for r in records)
 
+    # --- batched engine: per-bucket table (shape-bucketed compile cache) ---
+    by_bucket: dict[str, list[StepRecord]] = {}
+    for r in records:
+        if r.bucket_key:
+            by_bucket.setdefault(r.bucket_key, []).append(r)
+    if by_bucket:
+        buckets = {}
+        for key, rs in by_bucket.items():
+            n = len(rs)
+            buckets[key] = {
+                "steps": n,
+                "mean_batch_size": sum(r.batch_size for r in rs) / n,
+                "mean_node_occupancy": sum(r.node_occupancy for r in rs) / n,
+                "mean_edge_occupancy": sum(r.edge_occupancy for r in rs) / n,
+                "mean_padding_waste_frac": sum(
+                    r.padding_waste_frac for r in rs) / n,
+                "mean_structures_per_sec": sum(
+                    r.structures_per_sec for r in rs) / n,
+            }
+        c["buckets"] = buckets
+        sps = [r.structures_per_sec for r in records
+               if r.structures_per_sec > 0]
+        if sps:
+            c["mean_structures_per_sec"] = sum(sps) / len(sps)
+
     # --- anomalies ---
     # stall detection is PER KIND: a DeviceMD chunk legitimately takes
     # hundreds of calculate-steps' worth of wall time, so a mixed
@@ -188,6 +227,18 @@ def aggregate(
                 f"padding occupancy {', '.join(low)} below "
                 f"{occupancy_floor:.2f} — sticky capacities far above the "
                 f"live graph (mostly-padded compute)"))
+    # per-bucket occupancy collapse: a bucket whose mean occupancy sits
+    # below the floor means the geometric ladder is quantizing this
+    # request-size population too coarsely (or the batcher under-fills) —
+    # most of each executable's padded lanes are waste
+    for key, b in (c.get("buckets") or {}).items():
+        occ = min(b["mean_node_occupancy"], b["mean_edge_occupancy"])
+        if 0 < occ < occupancy_floor:
+            rep.anomalies.append(Anomaly(
+                "bucket_occupancy_collapse", 0,
+                f"bucket {key}: mean occupancy {occ:.2f} over {b['steps']} "
+                f"step(s) below {occupancy_floor:.2f} — tune BucketPolicy "
+                f"growth/base or batch more structures per request"))
     for r in records:
         if r.halo_send_per_part and r.halo_imbalance() > imbalance_factor:
             rep.anomalies.append(Anomaly(

@@ -1,0 +1,456 @@
+"""Batched multi-structure engine: packing exactness, parity across all
+four model families, compile-count bounds under the shape-bucketed cache,
+and the vectorized relax/MD drivers.
+
+The exactness contract under test: block-diagonal packing, padding and
+masking NEVER change results — per-structure energies/forces/stresses
+(/magmoms) from ``BatchedPotential`` match the single-structure
+``DistPotential`` path to fp32 roundoff, for mixed batches of different
+sizes and species, including a 1-atom structure and an empty-padded slot.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import (Atoms, BatchedMD, BatchedPotential,
+                                      BatchedRelaxer, DistPotential,
+                                      MolecularDynamics, Relaxer)
+from distmlip_tpu.models import PairConfig, PairPotential
+from distmlip_tpu.partition import (BucketPolicy, bucket_key,
+                                    geometric_bucket, pack_structures)
+from distmlip_tpu.telemetry import JsonlSink, Telemetry
+
+
+def make_structure(rng, reps=(2, 1, 1), a=3.5, noise=0.05, n_species=2,
+                   species_lo=0):
+    """Perturbed fcc supercell as an Atoms object (numbers = species ids)."""
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, noise, (len(frac), 3))
+    z = rng.integers(species_lo, species_lo + n_species,
+                     len(frac)).astype(np.int32)
+    return Atoms(numbers=z, positions=cart, cell=lattice)
+
+
+def mixed_batch(rng):
+    """4 structures with different sizes, cells and species populations."""
+    return [
+        make_structure(rng, reps=(2, 1, 1)),
+        make_structure(rng, reps=(2, 2, 1), a=3.7, n_species=2,
+                       species_lo=1),
+        make_structure(rng, reps=(1, 1, 1), a=3.4),
+        make_structure(rng, reps=(3, 1, 1), a=3.6, n_species=3),
+    ]
+
+
+def assert_batched_matches_single(model, params, structs, rng,
+                                  compute_magmom=False, atol_f=5e-5,
+                                  rtol_e=5e-6):
+    bp = BatchedPotential(model, params, compute_magmom=compute_magmom)
+    res = bp.calculate(structs)
+    assert len(res) == len(structs)
+    sp = DistPotential(model, params, num_partitions=1,
+                       compute_magmom=compute_magmom)
+    for b, atoms in enumerate(structs):
+        ref = sp.calculate(atoms)
+        scale = max(1.0, abs(ref["energy"]))
+        assert abs(res[b]["energy"] - ref["energy"]) < rtol_e * scale, (
+            f"structure {b}: E {res[b]['energy']} vs {ref['energy']}")
+        np.testing.assert_allclose(res[b]["forces"], ref["forces"],
+                                   atol=atol_f)
+        np.testing.assert_allclose(res[b]["stress"], ref["stress"],
+                                   atol=atol_f)
+        if compute_magmom:
+            np.testing.assert_allclose(res[b]["magmoms"], ref["magmoms"],
+                                       atol=atol_f)
+
+
+# ---------------------------------------------------------------------------
+# packing invariants
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_pack_preserves_padding_contract(rng):
+    structs = mixed_batch(rng)
+    graph, host = pack_structures(structs, cutoff=3.2)
+    dst = np.asarray(graph.edge_dst[0])
+    assert np.all(np.diff(dst) >= 0), "packed edge_dst must be sorted"
+    # struct_id: real rows contiguous per structure, padded rows == B slots
+    sid = np.asarray(graph.struct_id[0])
+    mask = np.asarray(graph.node_mask[0])
+    assert graph.batch_size == 4
+    assert np.all(sid[~mask] == graph.batch_size)
+    for b, atoms in enumerate(structs):
+        s, e = host.node_offsets[b], host.node_offsets[b + 1]
+        assert e - s == len(atoms)
+        assert np.all(sid[s:e] == b)
+    # no edge crosses a block boundary
+    src = np.asarray(graph.edge_src[0])
+    emask = np.asarray(graph.edge_mask[0])
+    assert np.all(sid[src[emask]] == sid[dst[emask]])
+    # telemetry stats carry the bucket fields
+    assert host.stats["bucket_key"] == bucket_key(graph)
+    assert 0.0 <= host.stats["padding_waste_frac"] < 1.0
+    assert host.stats["batch_size"] == 4
+
+
+@pytest.mark.tier1
+def test_pack_rejects_conflicting_system_scalars(rng):
+    a = make_structure(rng)
+    b = make_structure(rng)
+    b.info["charge"] = 2
+    with pytest.raises(ValueError, match="conflicting"):
+        pack_structures([a, b], cutoff=3.2)
+
+
+def test_geometric_bucket_ladder():
+    assert geometric_bucket(1) == 128
+    assert geometric_bucket(128) == 128
+    assert geometric_bucket(129) == 256  # 181 -> lane-rounded
+    # bucket count over a range is logarithmic in the spread
+    sizes = np.unique(np.linspace(10, 5000, 400).astype(int))
+    buckets = {geometric_bucket(int(s)) for s in sizes}
+    spread = 5000 / 128
+    bound = int(np.ceil(np.log(spread) / np.log(2 ** 0.5))) + 2
+    assert len(buckets) <= bound
+    # monotone and always sufficient
+    for s in sizes:
+        assert geometric_bucket(int(s)) >= s
+    pol = BucketPolicy()
+    assert pol.get("edges", 300) == geometric_bucket(300)
+    assert pol.get_small(3) == 4
+    assert pol.get_small(8) == 8
+
+
+# ---------------------------------------------------------------------------
+# parity: batched == single-structure path, all four model families
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_parity_chgnet_with_magmoms(rng):
+    from distmlip_tpu.models.chgnet import CHGNet, CHGNetConfig
+
+    cfg = CHGNetConfig(num_species=4, units=16, num_rbf=6, num_angle=4,
+                       num_blocks=2, cutoff=3.2, bond_cutoff=2.6)
+    model = CHGNet(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    assert_batched_matches_single(model, params, mixed_batch(rng), rng,
+                                  compute_magmom=True)
+
+
+@pytest.mark.tier1
+def test_parity_tensornet(rng):
+    from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+
+    model = TensorNet(TensorNetConfig(num_species=4, units=16, num_rbf=8,
+                                      num_layers=2, cutoff=3.2))
+    params = model.init(jax.random.PRNGKey(0))
+    assert_batched_matches_single(model, params, mixed_batch(rng), rng)
+
+
+def test_parity_mace(rng):
+    from distmlip_tpu.models import MACE, MACEConfig
+
+    model = MACE(MACEConfig(
+        num_species=4, channels=16, l_max=2, a_lmax=2, hidden_lmax=1,
+        correlation=3, num_interactions=2, num_bessel=6, radial_mlp=16,
+        cutoff=3.2, avg_num_neighbors=12.0))
+    params = model.init(jax.random.PRNGKey(0))
+    assert_batched_matches_single(model, params, mixed_batch(rng), rng)
+
+
+def test_parity_escn(rng):
+    from distmlip_tpu.models import ESCN, ESCNConfig
+
+    model = ESCN(ESCNConfig(num_species=4, channels=16, l_max=2,
+                            num_layers=2, num_bessel=6, num_experts=4,
+                            cutoff=3.2, avg_num_neighbors=12.0))
+    params = model.init(jax.random.PRNGKey(0))
+    assert_batched_matches_single(model, params, mixed_batch(rng), rng)
+
+
+@pytest.mark.tier1
+def test_parity_one_atom_and_empty_padded_slot(rng):
+    """B=3 real structures (one a single isolated atom, zero edges) pad to
+    4 batch slots; the empty slot must read E=0 and perturb nothing."""
+    from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+
+    model = TensorNet(TensorNetConfig(num_species=4, units=16, num_rbf=8,
+                                      num_layers=2, cutoff=3.2))
+    params = model.init(jax.random.PRNGKey(0))
+    one_atom = Atoms(numbers=[1], positions=[[6.0, 6.0, 6.0]],
+                     cell=np.eye(3) * 12.0)
+    structs = [make_structure(rng), one_atom, make_structure(rng, a=3.7)]
+    bp = BatchedPotential(model, params)
+    res = bp.calculate(structs)
+    graph, _host = pack_structures(structs, cutoff=3.2)
+    assert graph.batch_size == 4  # 3 real + 1 empty-padded slot
+    assert_batched_matches_single(model, params, structs, rng)
+    assert res[1]["forces"].shape == (1, 3)
+    np.testing.assert_allclose(res[1]["forces"], 0.0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# shape-bucketed compile cache
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_compile_count_bounded_over_random_size_stream(rng):
+    """A stream of >= 20 randomly sized requests must hit a small fixed
+    set of compiled executables (one per geometric shape bucket), not one
+    compile per novel (n_atoms, n_edges) shape."""
+    model = PairPotential(PairConfig(cutoff=3.0, kind="lj"))
+    params = model.init()
+    bp = BatchedPotential(model, params)
+    sizes = rng.integers(6, 180, size=20)
+    seen_keys = set()
+    for n in sizes:
+        box = max(4.0, (float(n) ** (1 / 3)) * 2.6)
+        pos = rng.random((int(n), 3)) * box
+        atoms = Atoms(numbers=np.full(int(n), 14), positions=pos,
+                      cell=np.eye(3) * box)
+        bp.calculate([atoms])
+        seen_keys.add(bp.last_bucket_key)
+    # compiles == distinct shape buckets, bounded by the geometric ladder:
+    # each of the two bucketed dims (nodes, edges) contributes at most
+    # ceil(log_growth(spread)) + 1 rungs, and the jit cache sees only
+    # their observed combinations
+    n_spread = 180 / 6
+    per_dim = int(np.ceil(np.log(n_spread) / np.log(2 ** 0.5))) + 1
+    assert bp.compile_count == len(seen_keys)
+    assert bp.compile_count <= per_dim + 3, (
+        f"{bp.compile_count} compiles for 20 requests "
+        f"(buckets: {sorted(seen_keys)})")
+    assert bp.compile_count < 20
+    # replaying the same stream adds ZERO compiles (stateless buckets)
+    before = bp.compile_count
+    for n in sizes[:5]:
+        box = max(4.0, (float(n) ** (1 / 3)) * 2.6)
+        pos = rng.random((int(n), 3)) * box
+        bp.calculate([Atoms(numbers=np.full(int(n), 14), positions=pos,
+                            cell=np.eye(3) * box)])
+    assert bp.compile_count == before
+
+
+@pytest.mark.tier1
+def test_skin_cache_reuses_packed_graph(rng):
+    model = PairPotential(PairConfig(cutoff=3.0, kind="lj"))
+    params = model.init()
+    bp = BatchedPotential(model, params, skin=0.6)
+    structs = [make_structure(rng), make_structure(rng, reps=(2, 2, 1))]
+    bp.calculate(structs)
+    assert bp.rebuild_count == 1
+    for a in structs:
+        a.positions += rng.normal(0, 0.01, a.positions.shape)
+    bp.calculate(structs)
+    assert bp.rebuild_count == 1  # reused: positions-only upload
+    # exceed the skin budget -> rebuild
+    structs[0].positions += 0.5
+    bp.calculate(structs)
+    assert bp.rebuild_count == 2
+    # changing the structure list invalidates too
+    bp.calculate(structs[:1])
+    assert bp.rebuild_count == 3
+
+
+# ---------------------------------------------------------------------------
+# vectorized drivers
+# ---------------------------------------------------------------------------
+
+
+def _lj_model_params():
+    model = PairPotential(PairConfig(cutoff=3.5, kind="lj"))
+    params = model.init()
+    return model, {"eps": params["eps"] * 0.1, "sigma": params["sigma"]}
+
+
+@pytest.mark.tier1
+def test_batched_relax_converges_with_per_structure_masking():
+    # fixed local seed: the starting structures must be deterministically
+    # unconverged regardless of session-fixture rng state
+    rng = np.random.default_rng(7)
+    model, params = _lj_model_params()
+    bp = BatchedPotential(model, params, skin=0.4)
+    structs = [make_structure(rng, reps=(2, 1, 1), a=3.8, noise=0.18),
+               make_structure(rng, reps=(1, 1, 1), a=3.8, noise=0.22),
+               make_structure(rng, reps=(2, 2, 1), a=3.8, noise=0.15)]
+    res0 = bp.calculate(structs)
+    e0 = [r["energy"] for r in res0]
+    # every structure starts genuinely unconverged
+    assert all(np.abs(r["forces"]).max() > 0.05 for r in res0)
+    rx = BatchedRelaxer(bp, fmax=0.05)
+    out = rx.relax(structs, steps=300)
+    assert len(out) == 3
+    for b, res in enumerate(out):
+        assert res.converged, f"structure {b} did not converge"
+        assert np.abs(res.forces).max() < 0.05
+        assert res.energy <= e0[b] + 1e-6
+        assert res.nsteps > 0
+        # inputs untouched (relax works on copies)
+        assert not np.allclose(res.atoms.positions, structs[b].positions)
+
+
+def test_batched_relax_matches_single_relaxer():
+    """FIRE trajectories of a batch member match the single-structure
+    Relaxer (same optimizer constants) — masking/batching does not change
+    the optimizer math."""
+    rng = np.random.default_rng(7)
+    model, params = _lj_model_params()
+    structs = [make_structure(rng, reps=(2, 1, 1), a=3.8, noise=0.16),
+               make_structure(rng, reps=(1, 1, 1), a=3.8, noise=0.2)]
+    bp = BatchedPotential(model, params)
+    out_b = BatchedRelaxer(bp, fmax=0.05).relax(structs, steps=40)
+    sp = DistPotential(model, params, num_partitions=1)
+    for b, atoms in enumerate(structs):
+        ref = Relaxer(sp, optimizer="fire", fmax=0.05).relax(
+            atoms.copy(), steps=40)
+        assert out_b[b].converged == ref.converged
+        assert abs(out_b[b].energy - ref.energy) < 1e-4 * max(
+            1.0, abs(ref.energy))
+        np.testing.assert_allclose(out_b[b].atoms.positions,
+                                   ref.atoms.positions, atol=5e-3)
+
+
+@pytest.mark.tier1
+def test_batched_md_nve_matches_single_driver(rng):
+    model, params = _lj_model_params()
+    # species_lo=14: real elements (Si/P) so masses are non-zero — MD
+    # integrates 1/m (the pair model itself ignores species)
+    structs = [make_structure(rng, reps=(2, 1, 1), a=3.8, species_lo=14),
+               make_structure(rng, reps=(1, 1, 1), a=3.8, species_lo=14)]
+    for i, a in enumerate(structs):
+        a.set_maxwell_boltzmann_velocities(
+            300.0, rng=np.random.default_rng(i))
+    bp = BatchedPotential(model, params)
+    md = BatchedMD([a.copy() for a in structs], bp, ensemble="nve",
+                   timestep=1.0)
+    md.run(3)
+    sp = DistPotential(model, params, num_partitions=1)
+    for b, atoms in enumerate(structs):
+        ref = MolecularDynamics(atoms.copy(), sp, ensemble="nve",
+                                timestep=1.0)
+        ref.run(3)
+        np.testing.assert_allclose(md.atoms_list[b].positions,
+                                   ref.atoms.positions, atol=1e-4)
+        np.testing.assert_allclose(md.atoms_list[b].velocities,
+                                   ref.atoms.velocities, atol=1e-4)
+    assert md.nsteps == 3
+    assert np.all(np.isfinite(md.temperatures()))
+
+
+def test_batched_md_berendsen_steers_temperature_per_structure(rng):
+    model, params = _lj_model_params()
+    structs = [make_structure(rng, reps=(2, 2, 1), a=3.8, species_lo=14),
+               make_structure(rng, reps=(2, 2, 1), a=3.8, species_lo=14)]
+    for a in structs:
+        a.set_maxwell_boltzmann_velocities(500.0, rng=rng)
+    md = BatchedMD(structs, BatchedPotential(model, params),
+                   ensemble="nvt_berendsen", timestep=1.0,
+                   temperature=[200.0, 800.0], taut=20.0, seed=0)
+    t0 = md.temperatures()
+    md.run(30)
+    t1 = md.temperatures()
+    # each structure is steered toward ITS OWN target
+    assert abs(t1[0] - 200.0) < abs(t0[0] - 200.0)
+    assert abs(t1[1] - 800.0) < abs(t0[1] - 800.0)
+
+
+def test_batched_md_rejects_npt():
+    model, params = _lj_model_params()
+    with pytest.raises(ValueError, match="fixed-cell"):
+        BatchedMD([], BatchedPotential(model, params),
+                  ensemble="npt_berendsen")
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_batched_telemetry_records_and_bucket_report(rng, tmp_path):
+    from distmlip_tpu.telemetry.report import aggregate, read_jsonl
+
+    path = str(tmp_path / "batched.jsonl")
+    tel = Telemetry([JsonlSink(path)])
+    model, params = _lj_model_params()
+    bp = BatchedPotential(model, params, skin=0.4, telemetry=tel)
+    structs = mixed_batch(rng)
+    bp.calculate(structs)
+    for a in structs:
+        a.positions += rng.normal(0, 0.01, a.positions.shape)
+    bp.calculate(structs)
+    tel.close()
+    records = read_jsonl(path)
+    assert len(records) == 2
+    for rec in records:
+        assert rec.kind == "batched_calculate"
+        assert rec.batch_size == 4
+        assert rec.bucket_key  # non-empty bucket id
+        assert 0.0 <= rec.padding_waste_frac < 1.0
+        assert rec.structures_per_sec > 0
+    assert records[0].compiled and records[0].rebuild
+    assert records[1].graph_reused and not records[1].compiled
+    # round-trip through JSON keeps the batched fields typed
+    rec2 = type(records[0]).from_json(records[0].to_json())
+    assert rec2.bucket_key == records[0].bucket_key
+    # per-bucket table in the offline report
+    rep = aggregate(records)
+    buckets = rep.counters["buckets"]
+    assert records[0].bucket_key in buckets
+    b = buckets[records[0].bucket_key]
+    assert b["steps"] == 2
+    assert b["mean_batch_size"] == 4
+    assert "batched buckets" in rep.render()
+
+
+def test_bucket_occupancy_collapse_flagged():
+    from distmlip_tpu.telemetry import StepRecord
+    from distmlip_tpu.telemetry.report import aggregate
+
+    recs = [StepRecord(step=i, kind="batched_calculate",
+                       bucket_key="n1024_e4096_B8", batch_size=2,
+                       node_occupancy=0.10, edge_occupancy=0.12,
+                       padding_waste_frac=0.9, structures_per_sec=5.0)
+            for i in range(3)]
+    rep = aggregate(recs)
+    kinds = {a.kind for a in rep.anomalies}
+    assert "bucket_occupancy_collapse" in kinds
+
+
+# ---------------------------------------------------------------------------
+# batched runtime adds no collectives (halo audit)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_halo_audit_accepts_packed_batch():
+    import tools.halo_audit as ha
+
+    rc = ha.main(["--model", "pair", "--nparts", "2", "--batch", "3",
+                  "--json"])
+    assert rc == 0
+
+
+def test_batched_jsonl_sink_carries_structures_per_sec(rng, tmp_path):
+    """The bench contract: structures_per_sec values appear in the JSONL
+    telemetry sink for each batched step."""
+    path = str(tmp_path / "sps.jsonl")
+    tel = Telemetry([JsonlSink(path)])
+    model, params = _lj_model_params()
+    bp = BatchedPotential(model, params, telemetry=tel)
+    for B in (1, 3):
+        bp.calculate([make_structure(rng) for _ in range(B)])
+    tel.close()
+    lines = [json.loads(line) for line in open(path)]
+    sps = [ln["structures_per_sec"] for ln in lines]
+    assert len(sps) == 2 and all(v > 0 for v in sps)
+    assert {ln["batch_size"] for ln in lines} == {1, 3}

@@ -152,15 +152,17 @@ def test_canary_unavailable_retries_then_structured_failure():
     assert "UNAVAILABLE: sim" in out["detail"]
 
 
-def test_canary_hang_left_running_and_wedge_reported():
-    # the round-4 design point: a canary that neither exits nor fails is
-    # LEFT RUNNING (killing a mid-claim client renews the lease wedge) and
-    # the bench reports wedge_suspected without touching the backend itself
+def test_canary_hang_killed_and_wedge_reported():
+    # a canary that neither exits nor fails within budget means the grant
+    # is wedged: the canary process group is KILLED (TERM -> grace ->
+    # KILL), reported as canary=killed — BENCH_r05's left_running policy
+    # leaked the pid into the next round, so nothing may outlive the bench
     r = _run_snippet(
         "import os, json\n"
         "os.environ['BENCH_CANARY_LOG'] = '/tmp/bench_canary_test.log'\n"
         "os.environ['BENCH_CLAIM_TIMEOUT_S'] = '3'\n"
         "os.environ['BENCH_RETRIES'] = '1'\n"
+        "os.environ['BENCH_CANARY_KILL_GRACE_S'] = '2'\n"
         "import bench\n"
         "bench._CANARY_SRC = 'import time; time.sleep(120)'\n"
         "w = bench._Watchdog()\n"
@@ -172,17 +174,45 @@ def test_canary_hang_left_running_and_wedge_reported():
     assert r.returncode == 0, r.stderr
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["ok"] is False
-    assert out["t"]["canary"] == "left_running"
+    assert out["t"]["canary"] == "killed"
     assert out["t"]["wedge_suspected"] is True
-    assert "left running" in out["detail"]
+    assert "killed" in out["detail"]
     pid = out["t"]["canary_pid"]
-    # the canary must still be alive after the parent gave up on it
-    os.kill(pid, 0)  # raises ProcessLookupError if it was killed
-    # clean up the orphaned sleeper (a real canary would be left alone;
-    # this one is a plain time.sleep, safe to reap)
-    import signal
+    # the canary must be GONE (no leaked pid); rc 0 from kill would mean
+    # a live process (the parent reaped it, so ProcessLookupError)
+    import pytest
 
-    os.kill(pid, signal.SIGKILL)
+    with pytest.raises(ProcessLookupError):
+        os.kill(pid, 0)
+
+
+def test_canary_kill_escalates_through_sigterm_immune_canary():
+    # a canary that ignores SIGTERM must still die via the SIGKILL
+    # escalation within the grace windows
+    r = _run_snippet(
+        "import os, json\n"
+        "os.environ['BENCH_CANARY_LOG'] = '/tmp/bench_canary_test.log'\n"
+        "os.environ['BENCH_CLAIM_TIMEOUT_S'] = '3'\n"
+        "os.environ['BENCH_RETRIES'] = '1'\n"
+        "os.environ['BENCH_CANARY_KILL_GRACE_S'] = '1'\n"
+        "import bench\n"
+        "bench._CANARY_SRC = (\n"
+        "    'import signal, time;'\n"
+        "    'signal.signal(signal.SIGTERM, signal.SIG_IGN);'\n"
+        "    'time.sleep(120)')\n"
+        "w = bench._Watchdog()\n"
+        "ok, detail = bench._canary_claim(w)\n"
+        "w.finish()\n"
+        "print(json.dumps({'ok': ok, 't': bench._TELEMETRY}))\n",
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["t"]["canary"] == "killed"
+    import pytest
+
+    with pytest.raises(ProcessLookupError):
+        os.kill(out["t"]["canary_pid"], 0)
 
 
 def test_wedge_telemetry_present_on_watchdog_fire():

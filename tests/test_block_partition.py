@@ -96,7 +96,10 @@ def test_block_matches_single_device_chgnet(rng):
     out = pot(params, graph, graph.positions)
     e8 = float(out["energy"])
     f8 = host.gather_owned(np.asarray(out["forces"]), len(cart))
-    assert np.abs(f1).max() > 1e-2
+    # degeneracy floor: a position-independent model gives fp32-noise
+    # forces (<= ~1e-7); random-init magnitudes vary a few x across jax
+    # builds (observed 7e-3 here), so the floor must sit far below them
+    assert np.abs(f1).max() > 1e-5
     assert abs(e1 - e8) < 1e-4 * max(1.0, abs(e1))
     np.testing.assert_allclose(f1, f8, atol=2e-4)
 

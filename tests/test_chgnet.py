@@ -31,7 +31,12 @@ def test_distributed_matches_single_device(rng, params):
     cart, lattice, species = make_crystal(rng, reps=(8, 4, 4), a=A_LAT)
     e1, f1, s1 = _run(params, cart, lattice, species, 1)
     e4, f4, s4 = _run(params, cart, lattice, species, 4)
-    assert np.abs(f1).max() > 1e-2  # non-degeneracy guard
+    # non-degeneracy guard: a position-independent model gives forces at
+    # fp32 grad-noise level (<= ~1e-7). The floor sits well above that but
+    # far below any real random-init magnitude — the init's scale varies
+    # a few x across jax builds (observed 7e-3 here vs 1e-2 historically),
+    # which must not fail the guard.
+    assert np.abs(f1).max() > 1e-5
     assert abs(e1 - e4) < 1e-4 * max(1.0, abs(e1))
     np.testing.assert_allclose(f1, f4, atol=2e-4)
     np.testing.assert_allclose(s1, s4, atol=1e-5)
@@ -76,7 +81,9 @@ def test_forces_match_finite_difference(rng, params):
             return e, f
 
         _, forces = energy(cart)
-        assert np.abs(forces).max() > 1e-2
+        # degeneracy floor, not an init-magnitude check (see
+        # test_distributed_matches_single_device)
+        assert np.abs(forces).max() > 1e-5
         h = 1e-5
         for atom, ax in [(0, 0), (7, 1), (13, 2)]:
             cp, cm = cart.copy(), cart.copy()

@@ -22,9 +22,11 @@ def test_distributed_matches_single_device(rng, params):
     e1, f1, s1 = run_potential(MODEL.energy_fn, params, cart, lattice, species, CFG.cutoff, 1)
     e4, f4, s4 = run_potential(MODEL.energy_fn, params, cart, lattice, species, CFG.cutoff, 4)
     # guard against a degenerate (position-independent) model making this
-    # vacuous (random-init forces are O(5e-3): the torchmd-net invariant
-    # readout is quadratic in the tensor features)
-    assert np.abs(f1).max() > 1e-3
+    # vacuous: such a model gives forces at fp32 grad-noise level
+    # (<= ~1e-7). The floor sits above that but well below random-init
+    # magnitudes, whose scale varies a few x across jax builds (observed
+    # 7.5e-4 here vs O(5e-3) historically).
+    assert np.abs(f1).max() > 1e-5
     assert abs(e1 - e4) < 1e-4 * max(1.0, abs(e1))
     np.testing.assert_allclose(f1, f4, atol=1e-4)
     np.testing.assert_allclose(s1, s4, atol=1e-5)
@@ -80,7 +82,9 @@ def test_forces_match_finite_difference(rng, params):
             em, _ = energy(cm)
             f_fd = -(ep - em) / (2 * h)
             np.testing.assert_allclose(forces[atom, ax], f_fd, rtol=1e-5, atol=1e-7)
-        assert np.abs(forces).max() > 1e-3  # non-degenerate check
+        # degeneracy floor, not an init-magnitude check (see
+        # test_distributed_matches_single_device)
+        assert np.abs(forces).max() > 1e-5
     finally:
         jax.config.update("jax_enable_x64", False)
 

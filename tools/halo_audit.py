@@ -2,7 +2,7 @@
 """Audit collective counts of the graph-parallel potential programs.
 
     python tools/halo_audit.py [--model chgnet|pair|tensornet]
-        [--nparts 2] [--reps 4,2,2] [--per-scope] [--json]
+        [--nparts 2] [--reps 4,2,2] [--batch B] [--per-scope] [--json]
 
 Builds a small test system, traces the jitted potential under BOTH halo
 modes (plus the fused-aux and legacy site-readout programs when the model
@@ -11,7 +11,13 @@ jaxprs — the chip-free view of what the overlap-aware halo pipeline
 (ISSUE 2) saves per MD step. ``--per-scope`` additionally groups ppermutes
 by ``jax.named_scope`` name stack so the per-layer structure is visible.
 
-Exit codes: 0 ok, 2 usage.
+``--batch B`` additionally packs B jittered copies of the system into a
+block-diagonal batched graph (partition.pack_structures) and traces the
+batched potential at batch sizes 1 and B: collective counts MUST be
+independent of B (the batched engine is single-partition by design — a
+batch adds zero communication). A violation exits 3.
+
+Exit codes: 0 ok, 2 usage, 3 batched collective counts depend on B.
 """
 
 import argparse
@@ -80,6 +86,9 @@ def main(argv=None) -> int:
     ap.add_argument("--reps", default=None,
                     help="supercell reps gx,gy,gz (default: 2*nparts,2,2 so "
                          "slabs stay wider than the cutoff)")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="also audit the batched (packed) potential at "
+                         "batch sizes 1 and B; counts must not depend on B")
     ap.add_argument("--per-scope", action="store_true")
     ap.add_argument("--json", action="store_true")
     try:
@@ -137,9 +146,37 @@ def main(argv=None) -> int:
             entry["ppermutes_by_scope"] = dict(ppermutes_by_scope(jaxpr))
         report["programs"][name] = entry
 
+    batch_ok = True
+    if args.batch > 0:
+        from distmlip_tpu.calculators import Atoms
+        from distmlip_tpu.parallel import make_batched_potential_fn
+        from distmlip_tpu.partition import pack_structures
+
+        rng = __import__("numpy").random.default_rng(1)
+        base = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+
+        def jittered():
+            a = base.copy()
+            a.positions = a.positions + rng.normal(0, 0.02, a.positions.shape)
+            return a
+
+        bfn = make_batched_potential_fn(model.energy_fn)
+        totals = {}
+        for B in sorted({1, args.batch}):
+            bgraph, _ = pack_structures(
+                [jittered() for _ in range(B)], model.cfg.cutoff, bond_r,
+                use_bg, species_fn=lambda z: (z - 1).astype("int32"))
+            jaxpr = jax.make_jaxpr(bfn)(params, bgraph, bgraph.positions)
+            counts = count_collectives(jaxpr)
+            totals[B] = sum(counts.values())
+            report["programs"][f"batched[B={B}]"] = {
+                "total": totals[B], **dict(counts)}
+        batch_ok = len(set(totals.values())) == 1
+        report["batched_collectives_independent_of_B"] = batch_ok
+
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
-        return 0
+        return 0 if batch_ok else 3
     print(f"halo audit: model={args.model} P={args.nparts} "
           f"atoms={report['n_atoms']} e_split={graph.e_split}/{graph.e_cap}")
     for name, entry in report["programs"].items():
@@ -152,7 +189,10 @@ def main(argv=None) -> int:
     pot_l = report["programs"].get("potential[legacy]", {}).get("total", 0)
     if pot_c and pot_l:
         print(f"  coalesced/legacy collective ratio: {pot_c / pot_l:.2f}x")
-    return 0
+    if args.batch > 0:
+        verdict = "independent of B" if batch_ok else "DEPEND ON B (bug!)"
+        print(f"  batched collective counts: {verdict}")
+    return 0 if batch_ok else 3
 
 
 if __name__ == "__main__":

@@ -7,8 +7,17 @@ tools/tpu_probe_forever.sh as the probe body — a single file owns the
 
 Exit 0: grant healthy, marker written. Exit 1: claim raised (fast-fail,
 e.g. UNAVAILABLE). A HANG means the grant is wedged — callers must poll
-with a budget and LEAVE this process running on expiry (killing a
-mid-claim client renews the server-side lease wedge; round-3/4 lesson).
+with a budget and then KILL this process group on expiry (TERM -> grace
+-> KILL; bench.py _kill_canary_group). Policy history: rounds 3/4 showed
+the PARENT dying mid-claim renews the server-side lease wedge, so the
+original contract left a hung canary running; BENCH_r05 then showed the
+leaked pid (`canary: left_running`) holding its pending claim long after
+the round ended and serializing against the NEXT round's probe — a worse
+steady state than the wedge it documented. The canary is disposable by
+design (the parent never starts a claim of its own), so reaping it is
+the lesser risk; note the trade-off that a killed canary no longer
+writes /tmp/tpu_up when the lease eventually clears — the
+tpu_probe_forever.sh loop re-probes and owns that signal instead.
 """
 
 import sys

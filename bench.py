@@ -453,6 +453,51 @@ def _main_measured():
             batched_extras["batched_compiles"] = bpot.compile_count
         except Exception as e:  # noqa: BLE001 - batched is additive
             batched_extras["batched_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # serving-engine throughput: open-loop burst (submit everything, then
+    # harvest — maximum queueing pressure) through a ServeEngine at
+    # max_batch ∈ {1, 8}, requests/sec + p95 latency. Runs in THIS process
+    # after the canary-gated claim, so the wedge hardening above covers it;
+    # per-batch StepRecords ride the shared telemetry sinks. BENCH_SERVE=0
+    # skips.
+    serve_extras = {}
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        s_budget = float(os.environ.get("BENCH_SERVE_TIMEOUT_S", "600"))
+        watchdog.phase(
+            f"serve throughput measurement exceeded {s_budget:.0f}s",
+            s_budget)
+        try:
+            from distmlip_tpu.calculators import BatchedPotential
+            from distmlip_tpu.partition import BucketPolicy
+            from distmlip_tpu.serve import ServeEngine, run_open_loop
+
+            s_reps = int(os.environ.get("BENCH_SERVE_REPS", "2"))
+            n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "24"))
+            frac_s, lat_s = geometry.make_supercell(
+                unit, np.eye(3) * 3.9, (s_reps, s_reps, s_reps))
+            pool = []
+            for _ in range(8):
+                cart_s = geometry.frac_to_cart(frac_s, lat_s) + \
+                    rng.normal(0, 0.04, (len(frac_s), 3))
+                pool.append(Atoms(numbers=np.full(len(cart_s), 14),
+                                  positions=cart_s, cell=lat_s))
+            for B in (1, 8):
+                engine = ServeEngine(
+                    BatchedPotential(
+                        pot.model, pot.params, caps=BucketPolicy(),
+                        skin=float(os.environ.get("BENCH_SKIN", "0.5"))),
+                    max_batch=B, max_wait_s=0.005, admission="block",
+                    telemetry=telemetry)
+                run_open_loop(engine, pool, n_req, rate_hz=0.0)  # warm
+                rep = run_open_loop(engine, pool, n_req, rate_hz=0.0)
+                p95 = rep.latency_percentiles()["p95_s"]
+                serve_extras[f"serve_structs_per_sec_b{B}"] = round(
+                    rep.structures_per_sec, 2)
+                serve_extras[f"serve_p95_ms_b{B}"] = round(1e3 * p95, 2)
+                serve_extras[f"serve_compiles_b{B}"] = engine.compile_count
+                engine.close()
+        except Exception as e:  # noqa: BLE001 - serving is additive
+            serve_extras["serve_error"] = f"{type(e).__name__}: {e}"[:160]
     watchdog.finish()  # from here on the watchdog cannot print
     dt = float(np.median(watchdog.times))
     atoms_per_sec = len(atoms) / dt / max(len(jax.devices()), 1)
@@ -460,7 +505,7 @@ def _main_measured():
     # overlap-pipeline accounting: collective count of the measured mode AND
     # its A/B counterpart (host-side jaxpr traces — no device work), plus
     # the analytic-FLOP mfu for the measured steps
-    extras = {"halo_mode": halo_mode, **batched_extras}
+    extras = {"halo_mode": halo_mode, **batched_extras, **serve_extras}
     try:
         from distmlip_tpu.parallel import make_potential_fn
         from distmlip_tpu.parallel.audit import count_collectives

@@ -23,6 +23,7 @@ telemetry (bucket id, occupancy, padding waste) track this.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -90,8 +91,13 @@ class BatchedPotential:
         self.rebuild_count = 0
         self.last_timings: dict[str, float] = {}
         self.last_bucket_key = ""
+        self.last_stats: dict = {}
         self._step_counter = 0
         self._last_compile_count = 0
+        # serving: the ServeEngine scheduler thread and direct callers may
+        # share one BatchedPotential — serialize calculate() so the Verlet
+        # cache (check-then-use) and compile-cache counters stay coherent
+        self._lock = threading.RLock()
 
     def attach_telemetry(self, telemetry) -> None:
         """Same precedence policy as DistPotential: the potential's own
@@ -146,10 +152,16 @@ class BatchedPotential:
     def calculate(self, structures) -> list:
         """Evaluate a batch; returns one result dict per input structure
         (energy eV, forces eV/Å, stress eV/Å^3 ASE sign convention, plus
-        magmoms when ``compute_magmom``)."""
+        magmoms when ``compute_magmom``). Thread-safe: concurrent callers
+        (e.g. a ServeEngine scheduler plus a direct caller) serialize on an
+        internal lock so the Verlet cache is never torn mid-validation."""
         structures = list(structures)
         if not structures:
             return []
+        with self._lock:
+            return self._calculate_locked(structures)
+
+    def _calculate_locked(self, structures) -> list:
         t0 = time.perf_counter()
         reused = self._cache_valid(structures)
         if reused:
@@ -191,7 +203,12 @@ class BatchedPotential:
             "neighbor_s": t1 - t0, "partition_s": t2 - t1,
             "device_s": t3 - t2, "total_s": t3 - t0,
         }
-        self.last_bucket_key = (host.stats or {}).get("bucket_key", "")
+        self.last_stats = dict(host.stats or {})
+        # a reused (skin-cache) graph was packed for the SAME structure
+        # list, so its batch stats remain valid; refresh the real-count
+        # fields anyway in case the stats dict is shared downstream
+        self.last_stats["batch_size"] = len(structures)
+        self.last_bucket_key = self.last_stats.get("bucket_key", "")
         self._emit_record(host, len(structures), reused, t3 - t0)
         return results
 

@@ -338,6 +338,11 @@ def packed_stats(graph: PartitionedGraph, n_real_structures: int) -> dict:
         "node_occupancy": n_real / graph.n_cap if graph.n_cap else 0.0,
         "edge_occupancy": e_real / graph.e_cap if graph.e_cap else 0.0,
         "batch_size": n_real_structures,
+        "batch_slots": graph.batch_size,
+        # slot fill: real structures / padded batch slots — the serving
+        # scheduler's primary assembly-quality metric
+        "batch_occupancy": (n_real_structures / graph.batch_size
+                            if graph.batch_size else 0.0),
         "bucket_key": bucket_key(graph),
         "padding_waste_frac": 1.0 - live / slots if slots else 0.0,
     }

@@ -109,3 +109,30 @@ class BucketPolicy:
         of two, no lane-width rounding."""
         n = max(int(needed), 1)
         return 1 << (n - 1).bit_length()
+
+    def max_rungs(self, lo: int, hi: int) -> int:
+        """Upper bound on distinct ladder rungs a stream of sizes in
+        ``[lo, hi]`` can touch (lane rounding only collapses rungs). The
+        serving/adversarial compile-bound tests assert executable counts
+        against this."""
+        lo = max(int(lo), 1)
+        hi = max(int(hi), lo)
+        spread = hi / max(lo, self.base)
+        if spread <= 1.0:
+            return 1
+        return int(math.ceil(math.log(spread) / math.log(self.growth))) + 1
+
+    def ladder_bound(self, lo_total: int, hi_total: int,
+                     max_batch: int) -> int:
+        """Generous-but-logarithmic bound on the executables a serving
+        stream whose batch atom-totals span ``[lo_total, hi_total]`` can
+        compile: node and edge ladders each contribute at most
+        ``max_rungs`` rungs (edge counts track atom counts within a
+        constant factor, costing at most a constant number of extra rungs
+        — folded into the +2), crossed with the batch-slot powers of two
+        in play. The single source of truth for the load-test ``--check``
+        gate and the adversarial-stream tests."""
+        rungs = self.max_rungs(lo_total, hi_total)
+        b_slots = len({self.get_small(b)
+                       for b in range(1, max(int(max_batch), 1) + 1)})
+        return (2 * rungs + 2) * b_slots

@@ -1,0 +1,42 @@
+"""Async serving engine: continuous micro-batching over the batched
+multi-structure potential.
+
+The serving layer the ROADMAP north star ("serves heavy traffic") sits
+on: callers ``submit()`` single structures with priority/deadline and get
+Futures; a background scheduler assembles bucket-aware micro-batches
+(scheduler.plan_batch fills toward the BucketPolicy capacity ladder) and
+executes them through one shared ``BatchedPotential``, with admission
+control, a ``DistPotential`` fallback lane for oversized structures and
+per-request error isolation.
+
+Quick start::
+
+    from distmlip_tpu.calculators import BatchedPotential
+    from distmlip_tpu.serve import ServeEngine
+
+    engine = ServeEngine(BatchedPotential(model, params), max_batch=8)
+    future = engine.submit(atoms, priority=0, deadline=1.0)
+    result = future.result()     # same dict calculate() returns
+    engine.close()               # drains in-flight work first
+
+Load testing: ``tools/load_test.py`` (CLI) over ``loadgen.run_closed_loop``
+/ ``run_open_loop``.
+"""
+
+from .engine import (ADMISSION_MODES, EngineClosed, ServeEngine,
+                     ServeRejected, ServeStats)
+from .loadgen import LoadReport, run_closed_loop, run_open_loop
+from .scheduler import BatchPlan, plan_batch
+
+__all__ = [
+    "ServeEngine",
+    "ServeStats",
+    "ServeRejected",
+    "EngineClosed",
+    "ADMISSION_MODES",
+    "BatchPlan",
+    "plan_batch",
+    "LoadReport",
+    "run_closed_loop",
+    "run_open_loop",
+]

@@ -1,0 +1,559 @@
+"""In-process async inference engine: continuous micro-batching over the
+batched multi-structure potential.
+
+``ServeEngine`` is the serving layer the ROADMAP's "heavy traffic" north
+star needs on top of PR 3's block-diagonal packing: callers ``submit()``
+single structures and get ``concurrent.futures.Future``s back; a
+background scheduler thread continuously assembles micro-batches —
+bucket-aware (scheduler.plan_batch fills toward the BucketPolicy capacity
+ladder), priority/deadline-ordered, with a max-wait timer so a lone
+request is never starved — and executes them through ONE shared
+``BatchedPotential``. Oversized structures route to a ``DistPotential``
+fallback lane instead of blowing up the packed program's shape buckets.
+
+Robustness contract (tests/test_serve.py):
+
+- bounded queue with admission control: ``admission="reject"`` raises
+  ``ServeRejected`` when the queue is full, ``"block"`` parks the caller
+  until the scheduler frees a slot;
+- per-request error isolation: a poison structure (non-finite positions,
+  or anything that makes the batch raise) fails its OWN Future; the rest
+  of the batch returns results and the engine thread survives;
+- ``drain()`` flushes everything in flight deterministically and returns
+  with the queue empty and every Future resolved; ``close()`` drains by
+  default, then joins the scheduler thread;
+- the scheduler thread can never die: every execution path is wrapped so
+  an unexpected failure resolves the affected Futures exceptionally and
+  the loop continues.
+
+Telemetry: each dispatched batch emits a ``StepRecord`` (kind
+``serve_batch`` / ``serve_fallback``) carrying per-request queue-wait and
+latency lists, queue depth, batch occupancy and cumulative reject /
+deadline-miss counters — rendered by ``telemetry_report``'s "serving"
+section.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import heapq
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..telemetry import StepRecord
+from .scheduler import plan_batch
+
+ADMISSION_MODES = ("reject", "block")
+
+
+class ServeRejected(RuntimeError):
+    """Queue full under admission="reject" — the request was NOT enqueued."""
+
+
+class EngineClosed(RuntimeError):
+    """submit() after close(), or a pending request flushed by a
+    non-draining close."""
+
+
+@dataclass(order=True)
+class _Request:
+    """One queued request. Heap order: priority, then earliest deadline,
+    then submission order (FIFO within a class)."""
+
+    priority: int
+    deadline_abs: float      # absolute clock time; +inf = no deadline
+    seq: int
+    atoms: object = field(compare=False)
+    properties: tuple | None = field(compare=False, default=None)
+    future: Future = field(compare=False, default_factory=Future)
+    t_submit: float = field(compare=False, default=0.0)
+    n_atoms: int = field(compare=False, default=0)
+
+
+@dataclass
+class ServeStats:
+    """Cumulative engine counters (thread-safe reads: plain ints swapped
+    under the engine lock)."""
+
+    submitted: int = 0
+    completed: int = 0
+    failed: int = 0
+    cancelled: int = 0
+    rejected: int = 0
+    deadline_misses: int = 0
+    batches: int = 0
+    fallback_requests: int = 0
+    scheduler_errors: int = 0    # isolated loop faults (engine survived)
+    # bucket_key -> [batches, sum(batch_occupancy), sum(batch_size)]
+    buckets: dict = field(default_factory=dict)
+
+    def note_batch(self, bucket_key: str, occupancy: float, size: int):
+        b = self.buckets.setdefault(bucket_key, [0, 0.0, 0])
+        b[0] += 1
+        b[1] += occupancy
+        b[2] += size
+
+    def dominant_bucket(self) -> tuple[str, float] | None:
+        """(bucket_key, mean batch-slot occupancy) of the bucket that served
+        the most batches — the load test's acceptance metric."""
+        if not self.buckets:
+            return None
+        key = max(self.buckets, key=lambda k: self.buckets[k][0])
+        n, occ_sum, _ = self.buckets[key]
+        return key, occ_sum / max(n, 1)
+
+    def snapshot(self) -> dict:
+        d = {k: v for k, v in vars(self).items() if k != "buckets"}
+        d["buckets"] = {k: {"batches": v[0],
+                            "mean_batch_occupancy": v[1] / max(v[0], 1),
+                            "requests": v[2]}
+                        for k, v in self.buckets.items()}
+        return d
+
+
+def _finite_positions(atoms) -> bool:
+    pos = np.asarray(atoms.positions)
+    return bool(np.isfinite(pos).all())
+
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+class ServeEngine:
+    """Continuous micro-batching scheduler over a shared BatchedPotential.
+
+    Parameters
+    ----------
+    potential : BatchedPotential — the shared batched executor. Its Verlet
+        cache and compile cache are only touched from the scheduler thread
+        (and BatchedPotential.calculate is itself lock-guarded, so a caller
+        sharing the potential outside the engine stays safe).
+    fallback : optional DistPotential for structures larger than
+        ``max_batch_atoms`` — the single-structure (possibly
+        halo-partitioned) lane. Without one, oversized requests fail their
+        Future with ValueError.
+    max_batch : micro-batch slot budget (power of two keeps the packed
+        ``batch_size`` bucket stable).
+    max_wait_s : max time a request waits for co-batching before the
+        scheduler dispatches an underfilled batch (the lone-request
+        starvation bound). Measured on ``clock``.
+    max_queue : admission bound on queued (not yet dispatched) requests.
+    admission : "reject" (raise ServeRejected when full) or "block" (park
+        the submitter until space frees).
+    max_batch_atoms : per-structure size ceiling for the batched lane;
+        larger structures route to ``fallback``. None disables routing.
+    window : how deep past the queue head assembly may scan.
+    clock : monotonic-seconds callable; tests inject a fake clock so the
+        max-wait timer is deterministic (no real sleeps).
+    start : spawn the scheduler thread immediately. ``start=False`` lets
+        tests stage a queue and then start the engine for deterministic
+        assembly.
+    """
+
+    def __init__(
+        self,
+        potential,
+        fallback=None,
+        max_batch: int = 8,
+        max_wait_s: float = 0.02,
+        max_queue: int = 256,
+        admission: str = "reject",
+        max_batch_atoms: int | None = None,
+        window: int = 64,
+        telemetry=None,
+        clock=None,
+        start: bool = True,
+    ):
+        if admission not in ADMISSION_MODES:
+            raise ValueError(
+                f"admission {admission!r} not in {ADMISSION_MODES}")
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        self.potential = potential
+        self.fallback = fallback
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = int(max_queue)
+        self.admission = admission
+        self.max_batch_atoms = (int(max_batch_atoms)
+                                if max_batch_atoms is not None else None)
+        self.window = int(window)
+        self._real_clock = clock is None
+        self._clock = clock if clock is not None else time.monotonic
+        self.telemetry = telemetry
+        if telemetry is not None and hasattr(potential, "attach_telemetry"):
+            potential.attach_telemetry(telemetry)
+        self.stats = ServeStats()
+        self._cv = threading.Condition()
+        self._pending: list[_Request] = []   # heap
+        self._seq = itertools.count()
+        self._inflight = 0
+        self._draining = 0
+        self._closed = False     # submit() gate
+        self._closing = False    # scheduler exit signal
+        self._step = 0
+        self._thread: threading.Thread | None = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        if self._closed:
+            raise EngineClosed("engine already closed")
+        self._thread = threading.Thread(
+            target=self._loop, name="distmlip-serve", daemon=True)
+        self._thread.start()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    @property
+    def compile_count(self) -> int:
+        return getattr(self.potential, "compile_count", 0)
+
+    def kick(self) -> None:
+        """Wake the scheduler immediately (tests use this after advancing a
+        fake clock past the max-wait deadline)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Flush: dispatch everything queued (bypassing max-wait) and wait
+        until the queue is empty and no batch is in flight — i.e. every
+        submitted Future is resolved. Returns False on (real-time)
+        timeout."""
+        with self._cv:
+            if self._thread is None:
+                # no scheduler to flush the queue: report the truth instead
+                # of blocking forever
+                return not self._pending
+            self._draining += 1
+            self._cv.notify_all()
+            try:
+                return self._cv.wait_for(
+                    lambda: not self._pending and self._inflight == 0,
+                    timeout=timeout)
+            finally:
+                self._draining -= 1
+                self._cv.notify_all()
+
+    def close(self, drain: bool = True, timeout: float | None = None) -> None:
+        """Stop accepting work and shut the scheduler down.
+
+        ``drain=True`` (default) flushes queued work first so every
+        accepted Future resolves deterministically; ``drain=False`` fails
+        still-queued requests with ``EngineClosed`` (in-flight batches
+        still complete). Idempotent."""
+        with self._cv:
+            if self._closed and self._thread is None:
+                return
+            self._closed = True      # no new submits
+            if self._thread is None:
+                # never started: there is no scheduler to flush the queue,
+                # so a "graceful" close can only fail what's pending
+                drain = False
+            if not drain:
+                while self._pending:
+                    req = heapq.heappop(self._pending)
+                    if req.future.set_running_or_notify_cancel():
+                        req.future.set_exception(EngineClosed(
+                            "engine closed before this request was "
+                            "dispatched"))
+                        self.stats.failed += 1
+            self._closing = True
+            self._cv.notify_all()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+
+    def submit(self, atoms, properties=None, priority: int = 0,
+               deadline: float | None = None) -> Future:
+        """Enqueue one structure; returns a Future resolving to the same
+        result dict ``calculate`` produces (optionally trimmed to
+        ``properties``).
+
+        ``priority``: lower values dispatch first (default 0; negative =
+        urgent). ``deadline``: seconds from now (on the engine clock); used
+        for earliest-deadline-first ordering within a priority class and
+        for deadline-miss accounting — late results are still delivered.
+        """
+        now = self._clock()
+        req = _Request(
+            priority=int(priority),
+            deadline_abs=(now + float(deadline) if deadline is not None
+                          else float("inf")),
+            seq=next(self._seq),
+            atoms=atoms,
+            properties=tuple(properties) if properties is not None else None,
+            t_submit=now,
+            n_atoms=len(atoms),
+        )
+        with self._cv:
+            if self._closed:
+                raise EngineClosed("submit() on a closed engine")
+            if len(self._pending) >= self.max_queue:
+                if self.admission == "reject":
+                    self.stats.rejected += 1
+                    raise ServeRejected(
+                        f"queue full ({self.max_queue} pending); retry later "
+                        f"or construct with admission='block'")
+                self._cv.wait_for(
+                    lambda: len(self._pending) < self.max_queue
+                    or self._closed)
+                if self._closed:
+                    raise EngineClosed("engine closed while blocked on "
+                                       "admission")
+            self.stats.submitted += 1
+            heapq.heappush(self._pending, req)
+            self._cv.notify_all()
+        return req.future
+
+    # ------------------------------------------------------------------
+    # scheduler loop
+    # ------------------------------------------------------------------
+
+    def _wait_timeout(self, oldest_age: float) -> float:
+        """How long the scheduler may sleep before re-checking the max-wait
+        deadline. On the real clock this is the exact remaining budget; on
+        an injected (fake) clock fall back to a short poll so tests stay
+        deterministic without mapping fake seconds to real ones."""
+        if self._real_clock:
+            return max(min(self.max_wait_s - oldest_age, 0.05), 0.001)
+        return 0.005
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closing:
+                    self._cv.wait(timeout=0.05)
+                if not self._pending and self._closing:
+                    return
+                now = self._clock()
+                oldest = min(r.t_submit for r in self._pending)
+                ready = (len(self._pending) >= self.max_batch
+                         or self._draining > 0 or self._closing
+                         or now - oldest >= self.max_wait_s)
+                if not ready:
+                    self._cv.wait(timeout=self._wait_timeout(now - oldest))
+                    continue
+                batch, oversized = self._assemble_locked()
+                self._inflight += 1
+                self._cv.notify_all()   # admission slots freed
+            try:
+                self._run_dispatch(batch, oversized, now)
+            except BaseException:  # noqa: BLE001 - the loop must survive
+                self.stats.scheduler_errors += 1
+                import traceback
+                import warnings
+
+                warnings.warn("serve scheduler dispatch fault (isolated):\n"
+                              + traceback.format_exc(), stacklevel=1)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _assemble_locked(self) -> tuple[list[_Request], list[_Request]]:
+        """Pop the next micro-batch (and any oversized requests seen while
+        scanning) off the queue. Called under the lock."""
+        window: list[_Request] = []
+        limit = max(self.window, self.max_batch)
+        while self._pending and len(window) < limit:
+            window.append(heapq.heappop(self._pending))
+        oversized, normal = [], []
+        for r in window:
+            if (self.max_batch_atoms is not None
+                    and r.n_atoms > self.max_batch_atoms):
+                oversized.append(r)
+            else:
+                normal.append(r)
+        batch: list[_Request] = []
+        if normal:
+            plan = plan_batch([r.n_atoms for r in normal],
+                              policy=getattr(self.potential, "caps", None),
+                              max_batch=self.max_batch, window=limit)
+            chosen = set(plan.take)
+            for i, r in enumerate(normal):
+                if i in chosen:
+                    batch.append(r)
+                else:
+                    # not picked this round (occupancy rule / slot budget):
+                    # keep its queue position for the next batch
+                    heapq.heappush(self._pending, r)
+        return batch, oversized
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
+    def _run_dispatch(self, batch, oversized, t_dispatch) -> None:
+        for req in oversized:
+            self._run_fallback(req, t_dispatch)
+        if batch:
+            self._run_batch(batch, t_dispatch)
+
+    def _start_requests(self, requests) -> list[_Request]:
+        """Transition Futures to running; drop the ones a caller already
+        cancelled."""
+        live = []
+        for r in requests:
+            if r.future.set_running_or_notify_cancel():
+                live.append(r)
+            else:
+                self.stats.cancelled += 1
+        return live
+
+    def _resolve(self, req: _Request, result: dict, t_done: float) -> None:
+        if req.deadline_abs < t_done:
+            self.stats.deadline_misses += 1
+        if req.properties is not None:
+            keep = set(req.properties) | {"energy"}
+            result = {k: v for k, v in result.items() if k in keep}
+        self.stats.completed += 1
+        req.future.set_result(result)
+
+    def _fail(self, req: _Request, exc: BaseException) -> None:
+        self.stats.failed += 1
+        req.future.set_exception(exc)
+
+    def _run_fallback(self, req: _Request, t_dispatch: float) -> None:
+        live = self._start_requests([req])
+        if not live:
+            return
+        req = live[0]
+        t0 = time.perf_counter()
+        try:
+            if self.fallback is None:
+                raise ValueError(
+                    f"structure with {req.n_atoms} atoms exceeds "
+                    f"max_batch_atoms={self.max_batch_atoms} and no "
+                    f"fallback DistPotential is configured")
+            if not _finite_positions(req.atoms):
+                raise ValueError("non-finite positions")
+            result = self.fallback.calculate(req.atoms)
+        except Exception as e:  # noqa: BLE001 - isolate to this request
+            self._fail(req, e)
+            return
+        t_done = self._clock()
+        self.stats.fallback_requests += 1
+        self._resolve(req, result, t_done)
+        self._emit_record("serve_fallback", [req], t_dispatch, t_done,
+                          service_s=time.perf_counter() - t0)
+
+    def _run_batch(self, batch: list[_Request], t_dispatch: float) -> None:
+        batch = self._start_requests(batch)
+        if not batch:
+            return
+        # cheap poison screen: non-finite positions would feed NaN through
+        # the neighbor build; fail those Futures here and keep the rest
+        good = []
+        for r in batch:
+            if _finite_positions(r.atoms):
+                good.append(r)
+            else:
+                self._fail(r, ValueError(
+                    "non-finite positions (NaN/inf) in submitted structure"))
+        if not good:
+            return
+        t0 = time.perf_counter()
+        pot_stats: dict = {}
+        try:
+            # snapshot last_stats in the same critical section as the call:
+            # a direct caller sharing the potential (or this lane's own
+            # singles retry below) must not overwrite the stats between the
+            # batch executing and the engine reading its occupancy
+            lock = getattr(self.potential, "_lock", None)
+            with lock if lock is not None else _NULL_CTX:
+                results = self.potential.calculate([r.atoms for r in good])
+                pot_stats = dict(
+                    getattr(self.potential, "last_stats", None) or {})
+        except Exception:  # noqa: BLE001 - isolate per request below
+            # a batch-level fault (one request's graph build blowing up the
+            # pack) is isolated by re-running each request alone: the
+            # poison fails its own Future, the rest still get results
+            results = None
+        if results is None:
+            for r in good:
+                try:
+                    r_result = self.potential.calculate([r.atoms])[0]
+                except Exception as e:  # noqa: BLE001
+                    self._fail(r, e)
+                else:
+                    self._resolve(r, r_result, self._clock())
+            t_done = self._clock()
+        else:
+            t_done = self._clock()
+            for r, res in zip(good, results):
+                self._resolve(r, res, t_done)
+        service = time.perf_counter() - t0
+        self.stats.batches += 1
+        if results is not None:
+            occupancy = (len(good) / pot_stats["batch_slots"]
+                         if pot_stats.get("batch_slots") else 1.0)
+            self.stats.note_batch(pot_stats.get("bucket_key", ""), occupancy,
+                                  len(good))
+        else:
+            # the planned batch never ran as one packed program — the
+            # requests executed as B=1 singles, so attributing the intended
+            # batch's occupancy/bucket would corrupt the per-bucket stats
+            pot_stats = {}
+            occupancy = 0.0
+        self._emit_record("serve_batch", good, t_dispatch, t_done,
+                          service_s=service, pot_stats=pot_stats,
+                          batch_occupancy=occupancy)
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+
+    def _emit_record(self, kind: str, requests, t_dispatch, t_done,
+                     service_s: float, pot_stats: dict | None = None,
+                     batch_occupancy: float = 1.0) -> None:
+        self._step += 1
+        tel = self.telemetry
+        if tel is None or not tel.wants_records():
+            return
+        rec = StepRecord(
+            step=self._step, kind=kind,
+            timings={"service_s": service_s,
+                     "total_s": max(t_done - t_dispatch, service_s)},
+            batch_size=len(requests),
+            batch_occupancy=batch_occupancy,
+            queue_depth=self.queue_depth,
+            queue_wait_s=[round(t_dispatch - r.t_submit, 6)
+                          for r in requests],
+            request_latency_s=[round(t_done - r.t_submit, 6)
+                               for r in requests],
+            reject_count=self.stats.rejected,
+            deadline_miss_count=self.stats.deadline_misses,
+            structures_per_sec=(len(requests) / service_s
+                                if service_s > 0 else 0.0),
+        )
+        for k in ("bucket_key", "node_occupancy", "edge_occupancy",
+                  "padding_waste_frac", "n_atoms"):
+            if pot_stats and k in pot_stats:
+                setattr(rec, k, pot_stats[k])
+        tel.emit(rec)

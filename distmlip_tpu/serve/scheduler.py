@@ -1,0 +1,99 @@
+"""Micro-batch assembly planning for the serving engine.
+
+Pure host-side logic (no threads, no jax): given the sizes of the queued
+requests in dispatch order, pick the subset that forms the next
+micro-batch. The planner is bucket-aware — it fills toward the
+``BucketPolicy`` capacity ladder (partition/capacity.py) so the packed
+graph lands on a well-occupied rung: every admission either stays inside
+the current rung (strictly raising occupancy) or climbs to a rung where
+occupancy is at least as good. Because every emitted total quantizes onto
+the same geometric ladder the single-structure stream uses, scheduler-
+driven traffic inherits the ladder's compile bound (``max_rungs``); the
+adversarial streams in tests/test_capacity_adversarial.py assert this.
+
+Separated from the engine so the assembly policy is unit-testable against
+adversarial request streams without spinning up threads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..partition import BucketPolicy
+
+
+@dataclass
+class BatchPlan:
+    """Outcome of one assembly pass over the queue head.
+
+    ``take`` holds queue indices (into the order the planner saw) chosen
+    for this micro-batch; indices not taken stay queued in their original
+    order. ``skipped`` are indices the planner examined but left behind
+    because admitting them would have degraded rung occupancy.
+    """
+
+    take: list[int] = field(default_factory=list)
+    skipped: list[int] = field(default_factory=list)
+    total_atoms: int = 0
+    node_cap: int = 0
+
+    @property
+    def occupancy(self) -> float:
+        return self.total_atoms / self.node_cap if self.node_cap else 0.0
+
+
+def plan_batch(
+    sizes,
+    policy: BucketPolicy | None = None,
+    max_batch: int = 8,
+    window: int = 64,
+) -> BatchPlan:
+    """Greedy bucket-aware micro-batch selection.
+
+    ``sizes``: per-request atom counts in dispatch (priority/deadline)
+    order. The head request is always taken — the max-wait timer already
+    decided a batch must go out, so the oldest/most-urgent request is
+    never starved by the occupancy rule. Subsequent requests (scanned up
+    to ``window`` deep) are admitted while the batch stays under
+    ``max_batch`` slots and the admission keeps rung occupancy
+    nondecreasing:
+
+    - same node-capacity rung: always admit (occupancy strictly rises);
+    - next rung: admit if ``new_total/new_cap >= total/cap`` (climbing
+      does not dilute the rung);
+    - a rung-degrading candidate is skipped ONLY when the batch is at a
+      power-of-two slot count — the packed ``batch_size`` dimension rounds
+      to the next power of two, so stopping there wastes no batch slots.
+      Off a power-of-two boundary, the candidate is admitted anyway:
+      finishing the slot bucket beats the node-rung padding it costs
+      (batch-slot occupancy is the serving throughput lever; node padding
+      only costs masked lanes).
+
+    Skipped requests keep their queue position and seed (or join) the next
+    batch, so a huge request mixed into a small-request stream waits at
+    most until it reaches the queue head — then it is the seed and gets
+    its own appropriately-sized rung.
+    """
+    policy = policy or BucketPolicy()
+    plan = BatchPlan()
+    if not len(sizes):
+        return plan
+    total = int(sizes[0])
+    cap = policy.get("nodes", total)
+    plan.take.append(0)
+    for i in range(1, min(len(sizes), window)):
+        n = len(plan.take)
+        if n >= max_batch:
+            break
+        new_total = total + int(sizes[i])
+        new_cap = policy.get("nodes", new_total)
+        rung_ok = new_cap == cap or new_total * cap >= total * new_cap
+        at_slot_boundary = n & (n - 1) == 0   # 1, 2, 4, 8, ...
+        if rung_ok or not at_slot_boundary:
+            plan.take.append(i)
+            total, cap = new_total, new_cap
+        else:
+            plan.skipped.append(i)
+    plan.total_atoms = total
+    plan.node_cap = cap
+    return plan

@@ -62,6 +62,14 @@ class StepRecord:
     bucket_key: str = ""             # compiled-shape bucket id (n/e/B caps)
     padding_waste_frac: float = 0.0  # dead padded slots / total slots
     structures_per_sec: float = 0.0  # batch throughput (batch_size / total_s)
+    batch_occupancy: float = 0.0     # real structures / padded batch slots
+
+    # --- serving engine (serve/engine.py; kind serve_batch/serve_fallback) ---
+    queue_depth: int = 0             # requests still queued after dispatch
+    queue_wait_s: list[float] = field(default_factory=list)   # per request
+    request_latency_s: list[float] = field(default_factory=list)  # submit→done
+    reject_count: int = 0            # cumulative admission rejects at emit
+    deadline_miss_count: int = 0     # cumulative deadline misses at emit
 
     # --- halo pipeline + device-program cost model ---
     halo_mode: str = ""              # coalesced | legacy ("" = unknown)

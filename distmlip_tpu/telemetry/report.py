@@ -101,6 +101,27 @@ class Report:
                     f"{b['mean_edge_occupancy']:9.2f} "
                     f"{b['mean_padding_waste_frac']:6.2f} "
                     f"{b['mean_structures_per_sec']:10.1f}")
+        if c.get("serving"):
+            s = c["serving"]
+            out.append("")
+            out.append("serving (ServeEngine):")
+            out.append(
+                f"  requests={s['requests']} batches={s['batches']} "
+                f"mean_batch_size={s['mean_batch_size']:.1f} "
+                f"mean_batch_occupancy={s['mean_batch_occupancy']:.2f} "
+                f"max_queue_depth={s['max_queue_depth']}")
+            out.append(
+                f"  queue_wait_ms p50={1e3 * s['queue_wait_p50_s']:.1f} "
+                f"p95={1e3 * s['queue_wait_p95_s']:.1f} "
+                f"p99={1e3 * s['queue_wait_p99_s']:.1f}")
+            out.append(
+                f"  latency_ms    p50={1e3 * s['latency_p50_s']:.1f} "
+                f"p95={1e3 * s['latency_p95_s']:.1f} "
+                f"p99={1e3 * s['latency_p99_s']:.1f}")
+            out.append(
+                f"  rejects={s['rejects']} "
+                f"deadline_misses={s['deadline_misses']} "
+                f"fallback_batches={s['fallback_batches']}")
         if c.get("prefetch_skipped_hbm"):
             out.append(f"prefetch skipped by HBM guard: "
                        f"{c['prefetch_skipped_hbm']} step(s)")
@@ -197,6 +218,33 @@ def aggregate(
                if r.structures_per_sec > 0]
         if sps:
             c["mean_structures_per_sec"] = sum(sps) / len(sps)
+
+    # --- serving engine: per-request queue-wait / latency percentiles ---
+    serve = [r for r in records if r.kind in ("serve_batch",
+                                              "serve_fallback")]
+    if serve:
+        waits = sorted(w for r in serve for w in r.queue_wait_s)
+        lats = sorted(x for r in serve for x in r.request_latency_s)
+        batches = [r for r in serve if r.kind == "serve_batch"]
+        occs = [r.batch_occupancy for r in batches if r.batch_occupancy > 0]
+        c["serving"] = {
+            "requests": len(lats),
+            "batches": len(batches),
+            "fallback_batches": len(serve) - len(batches),
+            "mean_batch_size": (sum(r.batch_size for r in batches)
+                                / len(batches)) if batches else 0.0,
+            "mean_batch_occupancy": (sum(occs) / len(occs)) if occs else 0.0,
+            "max_queue_depth": max(r.queue_depth for r in serve),
+            "queue_wait_p50_s": percentile(waits, 0.50),
+            "queue_wait_p95_s": percentile(waits, 0.95),
+            "queue_wait_p99_s": percentile(waits, 0.99),
+            "latency_p50_s": percentile(lats, 0.50),
+            "latency_p95_s": percentile(lats, 0.95),
+            "latency_p99_s": percentile(lats, 0.99),
+            # cumulative counters: the LAST record carries the run totals
+            "rejects": max(r.reject_count for r in serve),
+            "deadline_misses": max(r.deadline_miss_count for r in serve),
+        }
 
     # --- anomalies ---
     # stall detection is PER KIND: a DeviceMD chunk legitimately takes

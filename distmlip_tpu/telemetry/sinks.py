@@ -19,6 +19,7 @@ path does no per-step work at all.
 from __future__ import annotations
 
 import sys
+import threading
 import time
 from collections import defaultdict
 
@@ -124,21 +125,31 @@ class JsonlSink(TelemetrySink):
     "w" starts a fresh artifact — one file is one run, which is what the
     report's medians/anomaly thresholds assume; pass mode="a" to append
     deliberately (e.g. resuming a run into the same file).
+
+    Thread-safe: the serving engine's scheduler thread and caller threads
+    emit into one sink concurrently, so each record is serialized OUTSIDE
+    the lock and written as one line-atomic ``write`` under it — lines
+    never interleave and ``close()`` flushes whatever was emitted.
     """
 
     def __init__(self, path: str, mode: str = "w"):
         if mode not in ("w", "a", "x"):
             raise ValueError(f"mode {mode!r} not in ('w', 'a', 'x')")
         self.path = str(path)
+        self._lock = threading.Lock()
         self._f = open(self.path, mode, buffering=1)
 
     def emit(self, record: StepRecord) -> None:
-        if not self._f.closed:
-            self._f.write(record.to_json() + "\n")
+        line = record.to_json() + "\n"   # serialize outside the lock
+        with self._lock:
+            if not self._f.closed:
+                self._f.write(line)
 
     def close(self) -> None:
-        if not self._f.closed:
-            self._f.close()
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
 
 
 class StderrSummarySink(TelemetrySink):

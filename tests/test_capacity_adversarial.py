@@ -1,0 +1,133 @@
+"""BucketPolicy / geometric_bucket under adversarial request streams,
+driven through the SERVING scheduler's batch assembly.
+
+The compile-bound contract: whatever order sizes arrive in — monotone
+ramps, alternating tiny/huge, B=1 spam — every packed shape quantizes
+onto the geometric capacity ladder, so the number of distinct XLA
+executables stays logarithmic in the size spread (``BucketPolicy.
+max_rungs``), never linear in the request count. These streams replay the
+scheduler's own assembly loop (``plan_batch`` over a live queue) into one
+shared ``BatchedPotential`` and assert ``compile_count`` against the
+ladder bound.
+"""
+
+import numpy as np
+import pytest
+
+from distmlip_tpu.calculators import Atoms, BatchedPotential
+from distmlip_tpu.models import PairConfig, PairPotential
+from distmlip_tpu.partition import BucketPolicy, geometric_bucket
+from distmlip_tpu.serve import plan_batch
+
+pytestmark = [pytest.mark.serve, pytest.mark.tier1]
+
+
+@pytest.fixture(scope="module")
+def pair_pot():
+    model = PairPotential(PairConfig(cutoff=3.0))
+    return BatchedPotential(model, model.init(), caps=BucketPolicy())
+
+
+def structure_of_size(rng, n_atoms: int) -> Atoms:
+    """n atoms at reasonable density in a cubic box (well-separated enough
+    for the pair model; exact energies are irrelevant here)."""
+    box = max(4.0, 1.8 * n_atoms ** (1.0 / 3.0) * 2.0)
+    pos = rng.random((n_atoms, 3)) * box
+    return Atoms(numbers=np.full(n_atoms, 14), positions=pos,
+                 cell=np.eye(3) * box)
+
+
+def drive_stream(pot, rng, sizes, max_batch=8):
+    """Replay the scheduler's assembly loop over a queue of request sizes:
+    plan_batch picks each micro-batch off the queue head (skipped requests
+    keep their position), the batch executes through the shared
+    BatchedPotential — exactly what ServeEngine._assemble_locked does,
+    minus the threads."""
+    queue = list(sizes)
+    caps = pot.caps
+    batch_totals = []
+    while queue:
+        plan = plan_batch(queue, policy=caps, max_batch=max_batch)
+        chosen = set(plan.take)
+        batch = [queue[i] for i in sorted(chosen)]
+        queue = [s for i, s in enumerate(queue) if i not in chosen]
+        pot.calculate([structure_of_size(rng, n) for n in batch])
+        batch_totals.append(sum(batch))
+    return batch_totals
+
+
+def ladder_bound(caps: BucketPolicy, totals, max_batch: int) -> int:
+    """The policy's own executable bound for a stream whose batch totals
+    span [min, max] — BucketPolicy.ladder_bound is the single source of
+    truth shared with tools/load_test.py --check."""
+    return caps.ladder_bound(min(totals), max(totals), max_batch)
+
+
+def test_monotone_increasing_stream(rng, pair_pot):
+    sizes = [int(n) for n in np.linspace(4, 160, 40)]
+    totals = drive_stream(pair_pot, rng, sizes)
+    bound = ladder_bound(pair_pot.caps, totals, 8)
+    assert pair_pot.compile_count <= bound, (
+        f"{pair_pot.compile_count} executables for a monotone ramp; "
+        f"ladder bound {bound}")
+
+
+def test_alternating_tiny_huge_stream(rng, pair_pot):
+    before = pair_pot.compile_count
+    sizes = [4 if i % 2 == 0 else 200 for i in range(30)]
+    totals = drive_stream(pair_pot, rng, sizes)
+    bound = ladder_bound(pair_pot.caps, totals, 8)
+    assert pair_pot.compile_count - before <= bound
+    # the tiny/huge alternation must not degenerate into one batch per
+    # request: the planner co-batches the tinies
+    assert len(totals) < 30
+
+
+def test_b1_spam_compiles_once(rng):
+    """30 identical-size single requests: ONE executable after the first."""
+    model = PairPotential(PairConfig(cutoff=3.0))
+    pot = BatchedPotential(model, model.init(), caps=BucketPolicy())
+    sizes = [24] * 30
+    drive_stream(pot, rng, sizes, max_batch=1)
+    assert pot.compile_count == 1, (
+        f"B=1 spam of one size compiled {pot.compile_count} executables")
+
+
+def test_b1_spam_varied_sizes_logarithmic(rng):
+    model = PairPotential(PairConfig(cutoff=3.0))
+    pot = BatchedPotential(model, model.init(), caps=BucketPolicy())
+    sizes = [int(s) for s in rng.integers(4, 300, 25)]
+    drive_stream(pot, rng, sizes, max_batch=1)
+    bound = ladder_bound(pot.caps, sizes, 1)
+    assert pot.compile_count <= bound < 25
+
+
+def test_plan_batch_never_loses_or_duplicates_requests():
+    """Queue-replay invariant: every request is taken exactly once,
+    whatever the stream shape."""
+    policy = BucketPolicy()
+    for sizes in ([4] * 17, list(range(4, 400, 13)),
+                  [4, 500] * 9, [123]):
+        queue = list(range(len(sizes)))   # request ids
+        sized = list(sizes)
+        served = []
+        while sized:
+            plan = plan_batch(sized, policy=policy, max_batch=8)
+            assert plan.take, "planner must always take the head"
+            assert plan.take[0] == 0
+            chosen = set(plan.take)
+            assert len(chosen) == len(plan.take)
+            served += [queue[i] for i in sorted(chosen)]
+            queue = [q for i, q in enumerate(queue) if i not in chosen]
+            sized = [s for i, s in enumerate(sized) if i not in chosen]
+        assert sorted(served) == list(range(len(sizes)))
+
+
+def test_geometric_bucket_is_stateless_and_monotone():
+    """Scheduler-facing properties: identical needs -> identical caps (no
+    history), and caps are monotone in the need — the assembly loop's
+    occupancy arithmetic relies on both."""
+    caps = [geometric_bucket(n) for n in range(1, 2000, 7)]
+    assert caps == [geometric_bucket(n) for n in range(1, 2000, 7)]
+    assert all(a <= b for a, b in zip(caps, caps[1:]))
+    assert all(geometric_bucket(n) >= n for n in range(1, 2000, 7))

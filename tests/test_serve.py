@@ -1,0 +1,511 @@
+"""Serving engine: scheduler assembly, admission control, max-wait timer
+(fake clock — no real sleeps beyond 0.1 s), error isolation, drain/close
+lifecycle, and the serving telemetry path (concurrent JsonlSink, report
+"serving" section).
+
+The acceptance contract under test: a poison request (NaN positions)
+fails ONLY its own Future while the rest of its batch returns results
+matching the single-structure ``DistPotential`` path; ``drain()`` returns
+with the queue empty and every Future resolved; the scheduler thread
+survives every failure mode.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import Atoms, BatchedPotential, DistPotential
+from distmlip_tpu.models import PairConfig, PairPotential
+from distmlip_tpu.partition import BucketPolicy
+from distmlip_tpu.serve import (EngineClosed, ServeEngine, ServeRejected,
+                                plan_batch)
+from distmlip_tpu.telemetry import JsonlSink, StepRecord, Telemetry
+
+pytestmark = pytest.mark.serve
+
+
+class FakeClock:
+    """Deterministic engine clock: time moves only when the test says so."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def pair():
+    model = PairPotential(PairConfig(cutoff=4.0))
+    return model, model.init()
+
+
+def make_structure(rng, reps=(1, 1, 1), a=3.5, noise=0.05):
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, noise, (len(frac), 3))
+    return Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lattice)
+
+
+def poison_structure(rng):
+    bad = make_structure(rng)
+    bad.positions = bad.positions.copy()
+    bad.positions[0, 0] = np.nan
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# plan_batch (pure assembly logic)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_plan_batch_head_always_taken():
+    # a huge head request seeds its own batch — never starved by the
+    # occupancy rule
+    plan = plan_batch([5000, 4, 4, 4], max_batch=8)
+    assert 0 in plan.take
+    assert plan.total_atoms >= 5000
+
+
+@pytest.mark.tier1
+def test_plan_batch_same_rung_always_admits():
+    # all tiny: everything fits the base rung -> take max_batch in order
+    plan = plan_batch([4] * 20, max_batch=8)
+    assert plan.take == list(range(8))
+    assert plan.node_cap == 128
+
+
+@pytest.mark.tier1
+def test_plan_batch_skips_only_at_slot_boundaries():
+    policy = BucketPolicy()
+    # seed fills rung 128 exactly at 4 slots; the 5th would climb to 256 at
+    # poor occupancy -> skipped, because 4 is a power-of-two slot count
+    plan = plan_batch([32, 32, 32, 32, 32, 32], policy, max_batch=8)
+    assert plan.take == [0, 1, 2, 3]
+    assert plan.skipped  # the rung-degrading candidates were left queued
+    assert plan.occupancy == 1.0
+    # off a slot boundary the degrading candidate is admitted anyway
+    # (finishing the slot bucket beats node padding): 3 x 40 = 120 on rung
+    # 128, then 40 -> 160/256 degrades but len=3 is not a power of two
+    plan = plan_batch([40, 40, 40, 40], policy, max_batch=8)
+    assert 3 in plan.take
+
+
+@pytest.mark.tier1
+def test_plan_batch_respects_max_batch_and_window():
+    plan = plan_batch([4] * 100, max_batch=8, window=50)
+    assert len(plan.take) == 8
+    plan = plan_batch([4] * 100, max_batch=64, window=10)
+    assert len(plan.take) == 10
+
+
+# ---------------------------------------------------------------------------
+# engine basics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_single_request_roundtrip(rng, pair):
+    model, params = pair
+    atoms = make_structure(rng)
+    with ServeEngine(BatchedPotential(model, params),
+                     max_wait_s=0.005) as engine:
+        res = engine.submit(atoms).result(timeout=60)
+        ref = DistPotential(model, params, num_partitions=1).calculate(atoms)
+        assert abs(res["energy"] - ref["energy"]) < 1e-5
+        np.testing.assert_allclose(res["forces"], ref["forces"], atol=5e-5)
+        assert engine.stats.completed == 1
+
+
+@pytest.mark.tier1
+def test_staged_queue_assembles_one_full_batch(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_batch=8,
+                         max_wait_s=0.005, start=False)
+    futs = [engine.submit(make_structure(rng)) for _ in range(8)]
+    engine.start()
+    for f in futs:
+        f.result(timeout=60)
+    assert engine.drain(timeout=30)
+    assert engine.stats.batches == 1          # one micro-batch of 8
+    assert engine.stats.completed == 8
+    dom = engine.stats.dominant_bucket()
+    assert dom is not None and dom[1] == 1.0  # all 8 slots filled
+    engine.close()
+
+
+@pytest.mark.tier1
+def test_priority_and_deadline_ordering(rng, pair):
+    model, params = pair
+    pot = BatchedPotential(model, params)
+    clock = FakeClock()
+    engine = ServeEngine(pot, max_batch=1, max_wait_s=0.0, start=False,
+                         clock=clock)
+    order = []
+    fut_lo = engine.submit(make_structure(rng), priority=5)
+    fut_hi = engine.submit(make_structure(rng), priority=-5)
+    # same priority class: earliest deadline first, then FIFO
+    fut_d2 = engine.submit(make_structure(rng), priority=0, deadline=200.0)
+    fut_d1 = engine.submit(make_structure(rng), priority=0, deadline=100.0)
+    for name, f in (("lo", fut_lo), ("hi", fut_hi), ("d2", fut_d2),
+                    ("d1", fut_d1)):
+        f.add_done_callback(lambda _f, n=name: order.append(n))
+    engine.start()
+    assert engine.drain(timeout=30)
+    engine.close()
+    assert order == ["hi", "d1", "d2", "lo"]
+
+
+@pytest.mark.tier1
+def test_max_wait_timer_fake_clock(rng, pair):
+    model, params = pair
+    clock = FakeClock()
+    engine = ServeEngine(BatchedPotential(model, params), max_batch=8,
+                         max_wait_s=50.0, clock=clock)
+    fut = engine.submit(make_structure(rng))
+    time.sleep(0.05)          # real time passes; fake clock is frozen
+    assert not fut.done(), "dispatched before the max-wait deadline"
+    clock.advance(51.0)       # past max_wait on the engine clock
+    engine.kick()
+    fut.result(timeout=60)
+    assert engine.stats.batches == 1
+    engine.close()
+
+
+@pytest.mark.tier1
+def test_deadline_miss_counted_but_result_delivered(rng, pair):
+    model, params = pair
+    clock = FakeClock()
+    engine = ServeEngine(BatchedPotential(model, params), max_batch=8,
+                         max_wait_s=0.0, start=False, clock=clock)
+    fut = engine.submit(make_structure(rng), deadline=0.5)
+    clock.advance(1.0)        # deadline expires while queued
+    engine.start()
+    res = fut.result(timeout=60)
+    assert "energy" in res    # late results are still delivered
+    assert engine.drain(timeout=30)
+    assert engine.stats.deadline_misses == 1
+    engine.close()
+
+
+@pytest.mark.tier1
+def test_properties_filter(rng, pair):
+    model, params = pair
+    with ServeEngine(BatchedPotential(model, params),
+                     max_wait_s=0.005) as engine:
+        res = engine.submit(make_structure(rng),
+                            properties=("energy", "forces")).result(timeout=60)
+    assert set(res) == {"energy", "forces"}
+
+
+def test_cancelled_future_is_skipped(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_batch=8,
+                         max_wait_s=0.005, start=False)
+    fut = engine.submit(make_structure(rng))
+    keep = engine.submit(make_structure(rng))
+    assert fut.cancel()
+    engine.start()
+    keep.result(timeout=60)
+    assert engine.drain(timeout=30)
+    assert engine.stats.cancelled == 1
+    assert engine.stats.completed == 1
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_admission_reject(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_queue=2,
+                         admission="reject", start=False)
+    engine.submit(make_structure(rng))
+    engine.submit(make_structure(rng))
+    with pytest.raises(ServeRejected):
+        engine.submit(make_structure(rng))
+    assert engine.stats.rejected == 1
+    engine.start()
+    assert engine.drain(timeout=30)
+    assert engine.stats.completed == 2
+    engine.close()
+
+
+@pytest.mark.tier1
+def test_admission_block_unblocks_on_dispatch(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_queue=1,
+                         admission="block", max_wait_s=0.005, start=False)
+    f1 = engine.submit(make_structure(rng))
+    blocked_fut = []
+    done = threading.Event()
+
+    def blocked_submit():
+        blocked_fut.append(engine.submit(make_structure(rng)))
+        done.set()
+
+    t = threading.Thread(target=blocked_submit, daemon=True)
+    t.start()
+    assert not done.wait(0.05), "submit should block while the queue is full"
+    engine.start()            # scheduler drains the queue, freeing the slot
+    assert done.wait(10), "blocked submit never unblocked"
+    f1.result(timeout=60)
+    blocked_fut[0].result(timeout=60)
+    engine.close()
+
+
+def test_admission_block_raises_on_close(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_queue=1,
+                         admission="block", start=False)
+    engine.submit(make_structure(rng))
+    raised = threading.Event()
+
+    def blocked_submit():
+        try:
+            engine.submit(make_structure(rng))
+        except EngineClosed:
+            raised.set()
+
+    t = threading.Thread(target=blocked_submit, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    engine.close(drain=False)
+    assert raised.wait(10), "blocked submitter not released by close()"
+
+
+# ---------------------------------------------------------------------------
+# error isolation (the acceptance contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_poison_request_fails_only_its_own_future(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_batch=8,
+                         max_wait_s=0.005, start=False)
+    goods = [make_structure(rng, reps=r)
+             for r in ((1, 1, 1), (2, 1, 1), (2, 2, 1))]
+    good_futs = [engine.submit(a) for a in goods]
+    bad_fut = engine.submit(poison_structure(rng))
+    engine.start()
+    with pytest.raises(ValueError, match="non-finite"):
+        bad_fut.result(timeout=60)
+    sp = DistPotential(model, params, num_partitions=1)
+    for atoms, fut in zip(goods, good_futs):
+        res = fut.result(timeout=60)
+        ref = sp.calculate(atoms)
+        # fp32 roundoff parity with the single-structure path
+        assert abs(res["energy"] - ref["energy"]) < 1e-5 * max(
+            1.0, abs(ref["energy"]))
+        np.testing.assert_allclose(res["forces"], ref["forces"], atol=5e-5)
+    # engine thread survived: it still serves
+    again = engine.submit(goods[0]).result(timeout=60)
+    assert "energy" in again
+    assert engine.drain(timeout=30)
+    assert engine.queue_depth == 0
+    assert engine.stats.failed == 1
+    assert engine.stats.scheduler_errors == 0
+    engine.close()
+
+
+class _StubPotential:
+    """Minimal BatchedPotential surface that raises on any batch containing
+    a marked structure — exercises the batch-fault -> singles-retry
+    isolation path (the poison screen can't catch this class of fault)."""
+
+    caps = BucketPolicy()
+    compile_count = 0
+    last_stats: dict = {}
+
+    def __init__(self):
+        self.batch_sizes = []
+
+    def attach_telemetry(self, telemetry):
+        pass
+
+    def calculate(self, structures):
+        self.batch_sizes.append(len(structures))
+        if any(a.info.get("poison") for a in structures):
+            raise RuntimeError("graph build blew up")
+        return [{"energy": float(len(a)), "free_energy": float(len(a))}
+                for a in structures]
+
+
+@pytest.mark.tier1
+def test_batch_fault_isolated_by_singles_retry(rng):
+    stub = _StubPotential()
+    engine = ServeEngine(stub, max_batch=8, max_wait_s=0.005, start=False)
+    goods = [make_structure(rng) for _ in range(3)]
+    bad = make_structure(rng)
+    bad.info["poison"] = True
+    good_futs = [engine.submit(a) for a in goods]
+    bad_fut = engine.submit(bad)
+    engine.start()
+    with pytest.raises(RuntimeError, match="blew up"):
+        bad_fut.result(timeout=60)
+    for f in good_futs:
+        assert f.result(timeout=60)["energy"] == float(len(goods[0]))
+    assert engine.drain(timeout=30)
+    engine.close()
+    # one failed batch of 4, then 4 singles
+    assert stub.batch_sizes[0] == 4
+    assert sorted(stub.batch_sizes[1:]) == [1, 1, 1, 1]
+    assert engine.stats.scheduler_errors == 0
+
+
+# ---------------------------------------------------------------------------
+# oversized-structure fallback lane
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_oversized_routes_to_fallback(rng, pair):
+    model, params = pair
+    big = make_structure(rng, reps=(2, 2, 2))   # 32 atoms
+    small = make_structure(rng)                 # 4 atoms
+    fallback = DistPotential(model, params, num_partitions=1)
+    engine = ServeEngine(BatchedPotential(model, params), fallback=fallback,
+                         max_batch_atoms=16, max_wait_s=0.005, start=False)
+    f_big = engine.submit(big)
+    f_small = engine.submit(small)
+    engine.start()
+    res = f_big.result(timeout=60)
+    ref = DistPotential(model, params, num_partitions=1).calculate(big)
+    assert abs(res["energy"] - ref["energy"]) < 1e-5 * max(
+        1.0, abs(ref["energy"]))
+    f_small.result(timeout=60)
+    assert engine.drain(timeout=30)
+    assert engine.stats.fallback_requests == 1
+    engine.close()
+
+
+def test_oversized_without_fallback_fails_future(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params),
+                         max_batch_atoms=16, max_wait_s=0.005)
+    fut = engine.submit(make_structure(rng, reps=(2, 2, 2)))
+    with pytest.raises(ValueError, match="max_batch_atoms"):
+        fut.result(timeout=60)
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: drain / close
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_drain_resolves_everything(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_batch=4,
+                         max_wait_s=10.0)   # long max-wait: drain must flush
+    futs = [engine.submit(make_structure(rng)) for _ in range(10)]
+    assert engine.drain(timeout=60)
+    assert engine.queue_depth == 0
+    assert all(f.done() for f in futs)
+    engine.close()
+
+
+@pytest.mark.tier1
+def test_close_is_graceful_and_idempotent(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_wait_s=10.0)
+    futs = [engine.submit(make_structure(rng)) for _ in range(3)]
+    engine.close()            # default: drains first
+    assert all(f.done() for f in futs)
+    engine.close()            # idempotent
+    with pytest.raises(EngineClosed):
+        engine.submit(make_structure(rng))
+
+
+def test_close_without_drain_fails_pending(rng, pair):
+    model, params = pair
+    engine = ServeEngine(BatchedPotential(model, params), max_wait_s=10.0,
+                         start=False)
+    futs = [engine.submit(make_structure(rng)) for _ in range(3)]
+    engine.close(drain=False)
+    for f in futs:
+        with pytest.raises(EngineClosed):
+            f.result(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# telemetry: serving records, concurrent JSONL, report section
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_serve_records_and_report_section(rng, pair, tmp_path):
+    from distmlip_tpu.telemetry.report import aggregate, read_jsonl
+
+    model, params = pair
+    path = tmp_path / "serve.jsonl"
+    tel = Telemetry([JsonlSink(str(path))])
+    engine = ServeEngine(BatchedPotential(model, params), max_batch=4,
+                         max_wait_s=0.005, telemetry=tel)
+    futs = [engine.submit(make_structure(rng)) for _ in range(8)]
+    for f in futs:
+        f.result(timeout=60)
+    engine.drain(timeout=30)
+    engine.close()
+    tel.close()
+    records = read_jsonl(str(path))
+    serve_recs = [r for r in records if r.kind == "serve_batch"]
+    assert serve_recs, "no serve_batch records emitted"
+    for r in serve_recs:
+        assert len(r.queue_wait_s) == r.batch_size
+        assert len(r.request_latency_s) == r.batch_size
+        assert all(w >= 0 for w in r.queue_wait_s)
+        assert all(lat >= w for lat, w in zip(r.request_latency_s,
+                                              r.queue_wait_s))
+        assert 0.0 < r.batch_occupancy <= 1.0
+    # batched_calculate records rode the same sink from the same thread
+    assert any(r.kind == "batched_calculate" for r in records)
+    rep = aggregate(records)
+    s = rep.counters["serving"]
+    assert s["requests"] == 8
+    assert s["rejects"] == 0 and s["deadline_misses"] == 0
+    assert s["latency_p99_s"] >= s["latency_p50_s"] >= 0
+    assert "serving (ServeEngine):" in rep.render()
+
+
+@pytest.mark.tier1
+def test_jsonl_sink_concurrent_emits_line_atomic(tmp_path):
+    path = tmp_path / "concurrent.jsonl"
+    sink = JsonlSink(str(path))
+    n_threads, per_thread = 8, 50
+
+    def writer(tid):
+        for i in range(per_thread):
+            sink.emit(StepRecord(step=i, kind=f"t{tid}",
+                                 timings={"total_s": 0.001 * i}))
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.close()
+    lines = path.read_text().splitlines()
+    assert len(lines) == n_threads * per_thread
+    kinds = set()
+    for line in lines:
+        rec = json.loads(line)   # every line parses: no interleaving
+        kinds.add(rec["kind"])
+    assert kinds == {f"t{t}" for t in range(n_threads)}
+    # emit after close: silent no-op
+    sink.emit(StepRecord())

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Load-test the ServeEngine: closed+open-loop traffic, latency percentiles.
+
+Drives a stream of mixed-size structures through an in-process
+``ServeEngine`` and prints ONE JSON line per mode (bench.py-style) with
+p50/p95/p99 latency, structures/sec, batch/bucket occupancy and engine
+counters — so serving throughput joins the perf trajectory. With
+``--jsonl`` the engine's per-batch StepRecords (and the batched
+potential's records) land in a telemetry JSONL renderable by
+``tools/telemetry_report.py`` (look for the "serving" section).
+
+``--check`` turns the run into an acceptance gate (used by tests and the
+verify flow): requests must complete, the dominant bucket's mean
+batch-slot occupancy must reach ``--occupancy-floor`` (default 0.95),
+compile count must stay within the BucketPolicy ladder bound, the
+scheduler thread must survive (zero isolated faults are NOT required —
+poison injection forces some — but the thread must still be serving), and
+``drain()`` must leave the queue empty with every Future resolved.
+Exit codes: 0 ok, 3 check failed, 2 usage.
+
+Smoke (verify flow): ``python tools/load_test.py --requests 12 --check``
+(~seconds on CPU with the default pair model).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the serving engine is single-partition by design; CPU is fine unless the
+# caller explicitly wants the real accelerator
+if not os.environ.get("DISTMLIP_REAL_DEVICES"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def make_pool(rng, n_structures: int, species: int = 14):
+    """Mixed-size perturbed fcc supercells (16..128 atoms)."""
+    from distmlip_tpu import geometry
+    from distmlip_tpu.calculators import Atoms
+
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    reps_pool = [(1, 1, 1), (2, 1, 1), (2, 2, 1), (2, 2, 2), (3, 1, 1)]
+    pool = []
+    for i in range(n_structures):
+        reps = reps_pool[int(rng.integers(len(reps_pool)))]
+        a = float(rng.uniform(3.4, 3.8))
+        frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+        cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+            0, 0.05, (len(frac), 3))
+        pool.append(Atoms(numbers=np.full(len(cart), species),
+                          positions=cart, cell=lattice))
+    return pool
+
+
+def build_model(name: str):
+    import jax
+
+    if name == "pair":
+        from distmlip_tpu.models import PairConfig, PairPotential
+
+        model = PairPotential(PairConfig(cutoff=4.0))
+        return model, model.init()
+    if name == "tensornet":
+        from distmlip_tpu.models import TensorNet, TensorNetConfig
+
+        model = TensorNet(TensorNetConfig(num_species=95, cutoff=4.5))
+        return model, model.init(jax.random.PRNGKey(0))
+    raise SystemExit(f"unknown --model {name!r} (pair | tensornet)")
+
+
+def run(args) -> int:
+    import time
+
+    from distmlip_tpu.calculators import BatchedPotential
+    from distmlip_tpu.partition import BucketPolicy
+    from distmlip_tpu.serve import (ServeEngine, run_closed_loop,
+                                    run_open_loop)
+    from distmlip_tpu.telemetry import JsonlSink, Telemetry
+
+    rng = np.random.default_rng(args.seed)
+    model, params = build_model(args.model)
+    pool = make_pool(rng, max(8, args.requests // 4))
+    caps = BucketPolicy()
+    telemetry = None
+    if args.jsonl:
+        telemetry = Telemetry([JsonlSink(args.jsonl)])
+    pot = BatchedPotential(model, params, caps=caps, skin=args.skin)
+    engine = ServeEngine(
+        pot, max_batch=args.max_batch, max_wait_s=args.max_wait,
+        max_queue=args.max_queue, admission=args.admission,
+        telemetry=telemetry)
+
+    # poison injection: NaN-position structures must fail ONLY their own
+    # Futures (error isolation); submitted mid-stream so they co-batch
+    poison_failures = 0
+    if args.poison:
+        from distmlip_tpu.calculators import Atoms
+
+        poison_futs = []
+        for _ in range(args.poison):
+            bad = pool[0].copy()
+            bad.positions = bad.positions.copy()
+            bad.positions[0, 0] = np.nan
+            poison_futs.append(engine.submit(bad))
+
+    modes = (("closed", "open") if args.mode == "both" else (args.mode,))
+    reports = {}
+    rc = 0
+    for mode in modes:
+        if mode == "closed":
+            rep = run_closed_loop(engine, pool, args.requests,
+                                  concurrency=args.concurrency)
+        else:
+            rep = run_open_loop(engine, pool, args.requests,
+                                rate_hz=args.rate, rng=rng)
+        reports[mode] = rep
+        line = {"metric": f"serve_{mode}_loop", **rep.summary(),
+                "max_batch": args.max_batch, "model": args.model,
+                "compile_count": engine.compile_count}
+        dom = engine.stats.dominant_bucket()
+        if dom:
+            line["dominant_bucket"] = dom[0]
+            line["dominant_bucket_occupancy"] = round(dom[1], 3)
+        print(json.dumps(line), flush=True)
+
+    if args.poison:
+        for f in poison_futs:
+            try:
+                f.result(timeout=60)
+            except Exception:  # noqa: BLE001 - expected: isolated failure
+                poison_failures += 1
+
+    drained = engine.drain(timeout=120)
+    depth_after_drain = engine.queue_depth
+    stats = engine.stats.snapshot()
+    t0 = time.perf_counter()
+    engine.close()
+    close_s = time.perf_counter() - t0
+
+    summary = {
+        "metric": "serve_load_test",
+        "requests": sum(r.n_requests for r in reports.values()),
+        "ok": sum(r.n_ok for r in reports.values()),
+        "failed": sum(r.n_failed for r in reports.values()),
+        "rejected": sum(r.n_rejected for r in reports.values()),
+        "poison_injected": args.poison,
+        "poison_failed": poison_failures,
+        "compile_count": engine.compile_count,
+        "scheduler_errors": stats["scheduler_errors"],
+        "drained": bool(drained),
+        "queue_depth_after_drain": depth_after_drain,
+        "close_s": round(close_s, 3),
+    }
+    dom = engine.stats.dominant_bucket()
+    if dom:
+        summary["dominant_bucket"] = dom[0]
+        summary["dominant_bucket_occupancy"] = round(dom[1], 3)
+    if telemetry is not None:
+        telemetry.close()
+        summary["jsonl"] = args.jsonl
+
+    if args.check:
+        # BucketPolicy compile bound: node/edge rungs over the pool's size
+        # spread, times the few batch-slot powers of two in play
+        n_atoms = [len(a) for a in pool]
+        bound = caps.ladder_bound(min(n_atoms),
+                                  sum(sorted(n_atoms)[-args.max_batch:]),
+                                  args.max_batch)
+        checks = {
+            # every request completed and the scheduler thread served the
+            # whole run (a dead thread would strand Futures/drain forever)
+            "all_ok": summary["ok"] == summary["requests"],
+            "no_stray_failures": summary["failed"] == 0,
+            "poison_isolated": poison_failures == args.poison,
+            "occupancy": (dom is not None
+                          and dom[1] >= args.occupancy_floor),
+            "compile_bound": engine.compile_count <= bound,
+            "drained_clean": bool(drained) and depth_after_drain == 0,
+        }
+        summary["checks"] = checks
+        summary["compile_bound"] = bound
+        if not all(checks.values()):
+            summary["check"] = "FAIL"
+            print(json.dumps(summary), flush=True)
+            return 3
+        summary["check"] = "ok"
+    print(json.dumps(summary), flush=True)
+    return rc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--mode", choices=("closed", "open", "both"),
+                   default="both")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="closed-loop outstanding requests")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="open-loop arrival rate in req/s (0 = burst)")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait", type=float, default=0.02)
+    p.add_argument("--max-queue", type=int, default=4096)
+    p.add_argument("--admission", choices=("reject", "block"),
+                   default="block")
+    p.add_argument("--model", default="pair")
+    p.add_argument("--skin", type=float, default=0.0)
+    p.add_argument("--poison", type=int, default=0,
+                   help="inject N NaN-position requests (isolation probe)")
+    p.add_argument("--jsonl", default=None,
+                   help="write telemetry StepRecords here")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--check", action="store_true",
+                   help="assert acceptance criteria; exit 3 on failure")
+    p.add_argument("--occupancy-floor", type=float, default=0.95)
+    args = p.parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

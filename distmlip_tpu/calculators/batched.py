@@ -52,6 +52,14 @@ class BatchedPotential:
     ``caps`` is a ``BucketPolicy`` (geometric capacity ladder); pass a
     custom one to tune ``base``/``growth``/``multiple`` — coarser growth
     means fewer compiles and more padding waste.
+
+    ``device_rebuild`` ("auto" = on for non-bond-graph models): when the
+    Verlet cache invalidates but the structure LIST is unchanged (batched
+    relax/MD trajectories, repeated serving of the same batch), the packed
+    edge arrays are rebuilt ON DEVICE and swapped in place — positions-only
+    re-upload, no host repack, no recompile. A capacity overflow falls back
+    to the host repack (which may move to the next bucket rung);
+    ``DISTMLIP_DEVICE_REBUILD=0`` disables globally.
     """
 
     def __init__(
@@ -64,6 +72,7 @@ class BatchedPotential:
         caps: BucketPolicy | None = None,
         skin: float = 0.0,
         num_threads: int | None = None,
+        device_rebuild: bool | str = "auto",
         telemetry=None,
     ):
         self.model = model
@@ -89,6 +98,12 @@ class BatchedPotential:
             compute_stress=self.compute_stress, aux=self.compute_magmom)
         self._cache = None  # (graph, host, [(numbers, cell, pbc)])
         self.rebuild_count = 0
+        # device-resident packed refresh (partition.device_refresh_packed)
+        self.device_rebuild = (True if device_rebuild == "auto"
+                               else bool(device_rebuild))
+        self.rebuild_on_device_count = 0
+        self.rebuild_overflow_count = 0
+        self._refresh_spec = None  # (PackedStatic, arrays) for the cache
         self.last_timings: dict[str, float] = {}
         self.last_bucket_key = ""
         self.last_stats: dict = {}
@@ -116,10 +131,13 @@ class BatchedPotential:
     def _species(self, numbers: np.ndarray) -> np.ndarray:
         return map_species(numbers, self.species_map)
 
-    def _cache_valid(self, structures) -> bool:
-        if self.skin <= 0.0 or self._cache is None:
+    def _structures_match(self, structures) -> bool:
+        """Cached pack covers the SAME structure list (identity up to
+        positions) — the precondition for both skin reuse and the
+        positions-only device refresh."""
+        if self._cache is None:
             return False
-        _, host, keys = self._cache
+        _, _host, keys = self._cache
         if len(keys) != len(structures):
             return False
         for (numbers0, cell0, pbc0), atoms in zip(keys, structures):
@@ -128,12 +146,26 @@ class BatchedPotential:
                     and np.array_equal(cell0, atoms.cell)
                     and np.array_equal(pbc0, atoms.pbc)):
                 return False
+        return True
+
+    def _cache_valid(self, structures) -> bool:
+        if self.skin <= 0.0 or self._cache is None:
+            return False
+        if not self._structures_match(structures):
+            return False
+        _, host, _ = self._cache
         # Verlet criterion per structure: every block must stay within
         # the shared skin/2 budget for the packed graph to remain valid
         half = 0.5 * self.skin
         return all(
             max_displacement(atoms.positions, pos0) < half
             for pos0, atoms in zip(host.build_positions, structures))
+
+    def _device_refresh_eligible(self) -> bool:
+        from ..neighbors.device import device_rebuild_enabled
+
+        return (self.device_rebuild and self.skin > 0.0
+                and not self.use_bond_graph and device_rebuild_enabled())
 
     def _build(self, structures):
         import jax
@@ -147,7 +179,59 @@ class BatchedPotential:
         with annotate("distmlip/graph_upload"):
             graph = jax.device_put(graph)
         self.rebuild_count += 1
+        # refresh spec is built LAZILY on the first refresh attempt: a
+        # churning structure stream (every serving batch different) would
+        # otherwise pay the per-structure image-grid construction on every
+        # repack and never use it
+        self._refresh_spec = None
         return graph, host
+
+    def _try_device_refresh(self, structures):
+        """Rebuild the cached packed graph's edges ON DEVICE at the current
+        positions (structure list unchanged, Verlet budget spent). Returns
+        ``(graph, host, positions, rebuild_s)`` — the uploaded packed
+        positions are returned so the potential evaluation reuses them
+        (one pack + one transfer per step) — or None (overflow -> host
+        repack)."""
+        import jax.numpy as jnp
+
+        from ..partition import build_packed_refresh_spec, device_refresh_packed
+
+        graph, host, keys = self._cache
+        t0 = time.perf_counter()
+        dtype = np.asarray(graph.lattice).dtype
+        if self._refresh_spec is None:
+            # first refresh of this pack: build the spec now (and move its
+            # arrays to device once — later refreshes reuse them)
+            from ..neighbors.device import _as_device_arrays
+
+            static, arrays = build_packed_refresh_spec(
+                host, graph, self.cutoff + self.skin, dtype=dtype)
+            self._refresh_spec = (static, _as_device_arrays(arrays))
+        with annotate("distmlip/positions_upload"):
+            positions = jnp.asarray(host.scatter_positions(
+                [a.positions.astype(dtype) for a in structures],
+                dtype=dtype))
+        static, arrays = self._refresh_spec
+        with annotate("distmlip/device_rebuild"):
+            graph2, n_edges, overflow = device_refresh_packed(
+                static, arrays, graph, positions)
+            overflow = bool(overflow)  # one scalar sync gates correctness
+        if overflow:
+            self.rebuild_overflow_count += 1
+            return None
+        self.rebuild_count += 1
+        self.rebuild_on_device_count += 1
+        host.build_positions = [np.asarray(a.positions).copy()
+                                for a in structures]
+        if host.stats:
+            # keep the bucket telemetry truthful after the edge swap
+            n_edges = int(n_edges)
+            host.stats["n_edges_per_part"] = [n_edges]
+            host.stats["edge_occupancy"] = (
+                n_edges / graph.e_cap if graph.e_cap else 0.0)
+        self._cache = (graph2, host, keys)
+        return graph2, host, positions, time.perf_counter() - t0
 
     def calculate(self, structures) -> list:
         """Evaluate a batch; returns one result dict per input structure
@@ -164,19 +248,39 @@ class BatchedPotential:
     def _calculate_locked(self, structures) -> list:
         t0 = time.perf_counter()
         reused = self._cache_valid(structures)
+        refreshed = False
+        rebuild_s = 0.0
+        positions = None
         if reused:
             graph, host, _ = self._cache
         else:
-            graph, host = self._build(structures)
-            if self.skin > 0.0:
-                self._cache = (graph, host, [
-                    (a.numbers.copy(), a.cell.copy(), a.pbc.copy())
-                    for a in structures])
+            graph = host = None
+            if (self._device_refresh_eligible()
+                    and self._structures_match(structures)):
+                # same structures, positions drifted past skin/2: rebuild
+                # the packed edges on device instead of repacking on host
+                out = self._try_device_refresh(structures)
+                if out is not None:
+                    graph, host, positions, rebuild_s = out
+                    refreshed = True
+            if graph is None:
+                graph, host = self._build(structures)
+                if self.skin > 0.0:
+                    self._cache = (graph, host, [
+                        (a.numbers.copy(), a.cell.copy(), a.pbc.copy())
+                        for a in structures])
         t1 = time.perf_counter()
-        dtype = np.asarray(graph.lattice).dtype
-        with annotate("distmlip/positions_upload"):
-            positions = host.scatter_positions(
-                [a.positions.astype(dtype) for a in structures], dtype=dtype)
+        if positions is None:
+            import jax.numpy as jnp
+
+            dtype = np.asarray(graph.lattice).dtype
+            with annotate("distmlip/positions_upload"):
+                # jnp.asarray so BOTH paths (host scatter / device refresh)
+                # hand the potential identically-placed arrays — mixed
+                # numpy/Array inputs would split the jit cache in two
+                positions = jnp.asarray(host.scatter_positions(
+                    [a.positions.astype(dtype) for a in structures],
+                    dtype=dtype))
         t2 = time.perf_counter()
         with annotate("distmlip/batched_potential"):
             out = self._potential(self.params, graph, positions)
@@ -200,20 +304,25 @@ class BatchedPotential:
             results.append(res)
         t3 = time.perf_counter()
         self.last_timings = {
-            "neighbor_s": t1 - t0, "partition_s": t2 - t1,
+            "neighbor_s": (t1 - t0) - rebuild_s, "partition_s": t2 - t1,
             "device_s": t3 - t2, "total_s": t3 - t0,
         }
+        if refreshed:
+            self.last_timings["rebuild_s"] = rebuild_s
         self.last_stats = dict(host.stats or {})
         # a reused (skin-cache) graph was packed for the SAME structure
         # list, so its batch stats remain valid; refresh the real-count
         # fields anyway in case the stats dict is shared downstream
         self.last_stats["batch_size"] = len(structures)
+        self.last_stats["rebuild_count"] = int(not reused)
+        self.last_stats["rebuild_on_device"] = int(refreshed)
+        self.last_stats["rebuild_overflow_count"] = self.rebuild_overflow_count
         self.last_bucket_key = self.last_stats.get("bucket_key", "")
-        self._emit_record(host, len(structures), reused, t3 - t0)
+        self._emit_record(host, len(structures), reused, refreshed, t3 - t0)
         return results
 
     def _emit_record(self, host, n_structures: int, reused: bool,
-                     total_s: float) -> None:
+                     refreshed: bool, total_s: float) -> None:
         self._step_counter += 1
         tel = self.telemetry
         if tel is None or not tel.wants_records():
@@ -226,6 +335,9 @@ class BatchedPotential:
             timings=dict(self.last_timings),
             compile_cache_size=cache_size, compiled=compiled,
             graph_reused=reused, rebuild=not reused,
+            rebuild_count=int(not reused),
+            rebuild_on_device=int(refreshed),
+            rebuild_overflow_count=self.rebuild_overflow_count,
             structures_per_sec=(n_structures / total_s if total_s > 0
                                 else 0.0),
         )

@@ -125,6 +125,13 @@ class DistPotential:
         worst device's bytes_in_use exceeds this fraction of bytes_limit
         (the prefetch transiently double-books graph HBM); skips are
         counted in ``prefetch_skipped_hbm`` and surfaced in telemetry.
+    device_rebuild : "auto" (default) rebuilds the neighbor graph ON DEVICE
+        when the Verlet skin cache invalidates — single-partition,
+        non-bond-graph potentials only (``neighbors.device`` cell list +
+        in-place edge swap; no host FPIS, no re-upload, no re-trace). A
+        capacity overflow falls back to the host rebuild with grown caps
+        (counted in ``rebuild_overflow_count``). False — or the env kill
+        switch ``DISTMLIP_DEVICE_REBUILD=0`` — forces the host path.
     """
 
     def __init__(
@@ -147,6 +154,7 @@ class DistPotential:
         halo_mode: str = "coalesced",
         fused_site_readout: bool = True,
         collective_audit: bool = True,
+        device_rebuild: bool | str = "auto",
         telemetry=None,
     ):
         import jax
@@ -230,9 +238,20 @@ class DistPotential:
                             #  numbers, cell, pbc, system)
         self.last_timings: dict[str, float] = {}
         # graphs actually USED by a calculate() — synchronous builds plus
-        # ADOPTED background prefetches (both incremented on the main
-        # thread); discarded speculative builds don't count
+        # ADOPTED background prefetches and on-device refreshes (all
+        # incremented on the main thread); discarded speculative builds
+        # don't count
         self.rebuild_count = 0
+        # device-resident neighbor rebuild (neighbors/device.py): when the
+        # skin cache invalidates on a single-partition, non-bond-graph
+        # potential, the edge arrays are rebuilt on device and swapped in
+        # place instead of paying a host FPIS rebuild + re-upload
+        self.device_rebuild = (True if device_rebuild == "auto"
+                               else bool(device_rebuild))
+        self.rebuild_on_device_count = 0
+        self.rebuild_overflow_count = 0
+        self._nbr_spec = None       # (CellListStatic, arrays) or None
+        self._cell_cap_floor = 4    # grown after device-cell overflows
         # background-rebuild state (skin > 0 only): a single worker builds
         # the NEXT graph while the device steps on the current one
         self.async_rebuild = bool(async_rebuild) and self.skin > 0.0
@@ -366,6 +385,19 @@ class DistPotential:
             self.num_partitions = self._auto_partition_count(atoms)
             self._init_runtime()
 
+    def _device_refresh_eligible(self) -> bool:
+        """Whether the on-device neighbor rebuild can serve skin-cache
+        invalidations for this potential: single partition (no halo
+        re-partitioning), no bond graph (line-graph arrays can't be
+        refreshed in place), skin reuse on, and not globally disabled."""
+        from ..neighbors.device import device_rebuild_enabled
+
+        return (self.device_rebuild
+                and self.skin > 0.0
+                and self.num_partitions == 1
+                and not self.use_bond_graph
+                and device_rebuild_enabled())
+
     def _build_graph(self, atoms: Atoms):
         import jax
 
@@ -388,6 +420,21 @@ class DistPotential:
             )
         with annotate("distmlip/graph_upload"):
             graph = jax.device_put(graph, self._graph_shardings(graph))
+        if self._device_refresh_eligible():
+            # spec for the on-device refresh of THIS graph's capacity
+            # bucket (host-side binning, cheap); main thread only — the
+            # background prefetch path never runs for eligible configs.
+            # Arrays go to device ONCE here, not per refresh dispatch.
+            from ..neighbors.device import (_as_device_arrays,
+                                            build_cell_list_spec)
+
+            static, arrays = build_cell_list_spec(
+                atoms.cell, atoms.pbc, r_build, len(atoms), graph.n_cap,
+                graph.e_cap, positions=atoms.positions,
+                min_cell_cap=self._cell_cap_floor,
+                dtype=np.asarray(graph.lattice).dtype,
+            )
+            self._nbr_spec = (static, _as_device_arrays(arrays))
         return graph, host
 
     def _structure_matches(self, numbers0, cell0, pbc0, system0, atoms) -> bool:
@@ -448,6 +495,12 @@ class DistPotential:
         configs) construct with async_rebuild=False.
         """
         if not self.async_rebuild or self._prefetch is not None:
+            return
+        if self._device_refresh_eligible():
+            # the on-device refresh makes speculative host builds pointless
+            # (an invalidation costs one device dispatch, not a host FPIS
+            # rebuild) and keeping the worker out also keeps spec updates
+            # main-thread-only
             return
         pos0 = self._cache[3]
         if self._disp_frac(pos0, atoms.positions) < self.prefetch_frac:
@@ -512,6 +565,85 @@ class DistPotential:
                        build_atoms.cell.copy(), build_atoms.pbc.copy(),
                        self._system(build_atoms))
 
+    def _mark_cache_stale(self) -> None:
+        """Invalidate the skin cache's Verlet budget while KEEPING the
+        cached graph so the next ``_prepare`` can refresh it in place on
+        device (structure unchanged). Drops the cache entirely when the
+        device-refresh path is unavailable — the historical behavior."""
+        if self._cache is None:
+            return
+        if not (self._device_refresh_eligible()
+                and self._nbr_spec is not None):
+            self._cache = None
+            return
+        graph, host, shard, pos0, *rest = self._cache
+        self._cache = (graph, host, shard, np.full_like(pos0, np.inf),
+                       *rest)
+
+    def _install_refreshed(self, graph, build_positions) -> None:
+        """Swap a device-refreshed graph (same structure, same shapes) into
+        the skin cache with the positions it was rebuilt at. Used by the
+        in-potential refresh and by DeviceMD's in-loop rebuild."""
+        if self._cache is None:
+            return
+        _g, host, shard, _pos0, numbers, cell, pbc, system = self._cache
+        self._cache = (graph, host, shard,
+                       np.asarray(build_positions, dtype=np.float64).copy(),
+                       numbers, cell, pbc, system)
+
+    def _try_device_refresh(self, atoms: Atoms):
+        """Rebuild the cached graph's edges ON DEVICE at the current
+        positions (skin-cache invalidation, structure unchanged). Returns
+        ``(graph, host, positions)`` ready for the jitted potential, or
+        None when ineligible / structure changed / capacity overflowed (the
+        caller then takes the host rebuild path, which grows caps)."""
+        import jax
+
+        if (self._cache is None or self._nbr_spec is None
+                or not self._device_refresh_eligible()):
+            return None
+        graph, host, pos_sharding, _pos0, numbers0, cell0, pbc0, system0 = \
+            self._cache
+        if not self._structure_matches(numbers0, cell0, pbc0, system0, atoms):
+            return None
+        t0 = time.perf_counter()
+        dtype = np.asarray(graph.lattice).dtype
+        with annotate("distmlip/positions_upload"):
+            positions = host.scatter_global(
+                atoms.positions.astype(dtype), graph.n_cap)
+            positions = jax.device_put(positions, pos_sharding)
+        t1 = time.perf_counter()
+        from ..partition.graph import device_refresh_graph
+
+        static, arrays = self._nbr_spec
+        with annotate("distmlip/device_rebuild"):
+            graph2, n_edges, overflow = device_refresh_graph(
+                static, arrays, graph, positions)
+            overflow = bool(overflow)  # one scalar sync gates correctness
+        t2 = time.perf_counter()
+        if overflow:
+            from ..neighbors.device import grow_caps_after_overflow
+
+            self.rebuild_overflow_count += 1
+            # shared policy: pre-grow the sticky edge cap (the count is
+            # exact even past e_cap) or double the cell capacity, so the
+            # fallback host rebuild allocates buckets that actually fit
+            self._cell_cap_floor = grow_caps_after_overflow(
+                self.caps, int(n_edges), graph.e_cap, static.cell_cap,
+                self._cell_cap_floor)
+            self._nbr_spec = None  # rebuilt (with grown caps) on host build
+            return None
+        self.rebuild_count += 1
+        self.rebuild_on_device_count += 1
+        self.last_build_fresh = True  # built at the CURRENT positions
+        self._install_refreshed(graph2, atoms.positions)
+        self.last_timings = {"neighbor_s": 0.0, "partition_s": t1 - t0,
+                             "rebuild_s": t2 - t1, "prefetch_wait_s": 0.0}
+        self._prepare_flags = {"graph_reused": False, "rebuild": True,
+                               "prefetch_adopted": False,
+                               "rebuild_count": 1, "rebuild_on_device": 1}
+        return graph2, host, positions
+
     def _prepare(self, atoms: Atoms):
         """Build or reuse the partitioned graph; returns (graph, host,
         positions) ready for the jitted potential. ``last_build_fresh``
@@ -524,6 +656,12 @@ class DistPotential:
         self._validate_system(self._system(atoms))
         prefetch_wait = 0.0
         if not self._cache_valid(atoms):
+            # device-resident refresh first: same structure, positions
+            # drifted past skin/2 — rebuild the edges on the chip instead
+            # of stopping for a host FPIS rebuild + re-upload
+            refreshed = self._try_device_refresh(atoms)
+            if refreshed is not None:
+                return refreshed
             t_adopt = time.perf_counter()
             adopted = self._adopt_prefetch(atoms)
             # ONLY the adoption (possible future join) — not the validate/
@@ -535,7 +673,8 @@ class DistPotential:
                 graph, host, snap = adopted
                 self._install_cache(graph, host, snap)
                 self._prepare_flags = {"graph_reused": False, "rebuild": True,
-                                       "prefetch_adopted": True}
+                                       "prefetch_adopted": True,
+                                       "rebuild_count": 1}
             else:
                 graph, host = self._build_graph(atoms)
                 self.rebuild_count += 1
@@ -549,7 +688,8 @@ class DistPotential:
                     "partition_s": t2 - t1,
                     "prefetch_wait_s": prefetch_wait}
                 self._prepare_flags = {"graph_reused": False, "rebuild": True,
-                                       "prefetch_adopted": False}
+                                       "prefetch_adopted": False,
+                                       "rebuild_count": 1}
                 return graph, host, graph.positions
         else:
             self._prepare_flags = {"graph_reused": True, "rebuild": False,
@@ -630,13 +770,24 @@ class DistPotential:
             last[kind] = cache_size
         timings = {**self.last_timings, "total_s": total_s,
                    **(extra_timings or {})}
+        import dataclasses
+
+        # typed StepRecord fields passed through **extra (e.g. DeviceMD's
+        # per-chunk rebuild counts) land on the record; the rest ride extra
+        field_names = {f.name for f in dataclasses.fields(StepRecord)}
+        fields = {k: extra.pop(k) for k in list(extra)
+                  if k in field_names}
+        flags = {**self._prepare_flags, **fields}
+        overflow_count = flags.pop("rebuild_overflow_count",
+                                   self.rebuild_overflow_count)
         rec = StepRecord(
             step=self._step_counter, kind=kind, timings=timings,
             compile_cache_size=cache_size, compiled=compiled,
             device_memory=_device_memory_stats(),
             halo_mode=self.halo_mode,
             prefetch_skipped_hbm=self._prefetch_skip_hbm_flag,
-            extra=extra, **self._prepare_flags,
+            rebuild_overflow_count=overflow_count,
+            extra=extra, **flags,
         )
         self._prefetch_skip_hbm_flag = False
         stats = getattr(host, "stats", None)

@@ -7,6 +7,14 @@ npt_berendsen, npt_inhomogeneous_berendsen, npt_nose_hoover. Integrators run
 on the host in float64; each step calls the distributed potential once
 (velocity-Verlet based).
 
+Graph rebuilds under this driver follow the potential's skin cache: for a
+fixed-cell ensemble on a single-partition ``DistPotential(skin > 0)`` the
+Verlet invalidation is served by the ON-DEVICE neighbor rebuild
+(``neighbors/device.py``) — no host FPIS on the hot path. NPT ensembles
+rescale the cell, which invalidates the structure key and takes the host
+rebuild (correctly: the cell-list grid is sized to the lattice). For fully
+device-resident trajectories use ``DeviceMD``.
+
 Units: Å, fs, eV, amu, K; pressure in GPa at the API (converted internally).
 """
 

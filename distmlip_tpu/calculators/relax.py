@@ -10,6 +10,14 @@ parameterizations: ``cell_filter="unit"`` applies incremental symmetric
 strain (ASE UnitCellFilter analogue) and ``"exp"`` accumulates a symmetric
 generator S with cell = cell0 @ expm(S) (ASE ExpCellFilter analogue:
 first-order gradient -V sigma / cell_factor, exact exponential map).
+
+Neighbor refresh between optimizer steps rides the potential's skin cache:
+with ``DistPotential(skin > 0, num_partitions=1)`` (or a
+``BatchedRelaxer``'s ``BatchedPotential``) an invalidation triggers the
+ON-DEVICE edge rebuild (``neighbors/device.py``) instead of a host FPIS
+repack — fixed-cell relaxation never leaves the chip between force calls.
+Cell relaxation (``relax_cell=True``) changes the lattice, which
+invalidates the structure key and correctly takes the host rebuild.
 """
 
 from __future__ import annotations

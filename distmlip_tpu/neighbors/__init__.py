@@ -1,9 +1,22 @@
 from .python_ref import NeighborList, neighbor_list_brute, neighbor_list_numpy
 from .native import neighbor_list
+from .device import (CellListStatic, PackedStatic, build_cell_list_spec,
+                     build_packed_spec, cell_list_neighbors,
+                     device_neighbor_list, device_packed_neighbor_list,
+                     device_rebuild_enabled, packed_neighbors)
 
 __all__ = [
     "NeighborList",
     "neighbor_list",
     "neighbor_list_brute",
     "neighbor_list_numpy",
+    "CellListStatic",
+    "PackedStatic",
+    "build_cell_list_spec",
+    "build_packed_spec",
+    "cell_list_neighbors",
+    "device_neighbor_list",
+    "device_packed_neighbor_list",
+    "device_rebuild_enabled",
+    "packed_neighbors",
 ]

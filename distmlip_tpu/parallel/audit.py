@@ -71,6 +71,37 @@ def collective_counts(fn, *args, **kwargs) -> Counter:
     return count_collectives(jax.make_jaxpr(fn)(*args, **kwargs))
 
 
+def count_host_callbacks(closed_jaxpr) -> Counter:
+    """Counter of host-callback/transfer primitives in a traced program.
+
+    A program that should be fully device-resident (the DeviceMD chunk
+    with its in-loop neighbor rebuild) must show an EMPTY counter: any
+    ``pure_callback``/``io_callback``/infeed/outfeed would stall the
+    accelerator on the host mid-loop. Substring matching on "callback"
+    keeps this robust across jax versions' primitive renames."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    counts: Counter = Counter()
+    for eqn in _iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if ("callback" in name or "infeed" in name or "outfeed" in name
+                or name == "host_local_array_to_global_array"):
+            counts[name] += 1
+    return counts
+
+
+def count_primitives(closed_jaxpr, names) -> Counter:
+    """Occurrences of specific primitive names (nested jaxprs included) —
+    e.g. ``{"while", "sort"}`` to assert a rebuild lowered INTO the MD
+    loop rather than around it."""
+    names = frozenset(names)
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    counts: Counter = Counter()
+    for eqn in _iter_eqns(jaxpr):
+        if eqn.primitive.name in names:
+            counts[eqn.primitive.name] += 1
+    return counts
+
+
 def ppermutes_by_scope(closed_jaxpr) -> Counter:
     """Counter of name-stack string -> ppermute count (best effort: name
     stacks are source metadata and may be absent on some jax builds, in

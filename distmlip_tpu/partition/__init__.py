@@ -1,9 +1,11 @@
 from .plan import PartitionPlan
 from .partitioner import build_block_plan, build_plan, PartitionError
-from .graph import PartitionedGraph, HostGraphData, build_partitioned_graph
+from .graph import (PartitionedGraph, HostGraphData, build_partitioned_graph,
+                    device_refresh_graph, refresh_edges)
 from .capacity import (BucketPolicy, CapacityPolicy, geometric_bucket,
                        round_capacity)
-from .batch import PackedHostData, bucket_key, pack_structures, packed_stats
+from .batch import (PackedHostData, bucket_key, build_packed_refresh_spec,
+                    device_refresh_packed, pack_structures, packed_stats)
 
 __all__ = [
     "PartitionPlan",
@@ -13,6 +15,8 @@ __all__ = [
     "PartitionedGraph",
     "HostGraphData",
     "build_partitioned_graph",
+    "refresh_edges",
+    "device_refresh_graph",
     "CapacityPolicy",
     "BucketPolicy",
     "geometric_bucket",
@@ -21,4 +25,6 @@ __all__ = [
     "pack_structures",
     "packed_stats",
     "bucket_key",
+    "build_packed_refresh_spec",
+    "device_refresh_packed",
 ]

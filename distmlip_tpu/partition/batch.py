@@ -73,6 +73,11 @@ class PackedHostData:
     stats: dict | None = None       # telemetry: occupancy/waste/bucket
     # build-time positions per structure (Verlet skin cache validity)
     build_positions: list = field(default_factory=list)
+    # per-structure cells/pbc captured at pack time — the device edge
+    # refresh (device_refresh_packed) rebuilds each block's neighbor list
+    # with its own periodic geometry without re-touching the structures
+    cells: list = field(default_factory=list)
+    pbcs: list = field(default_factory=list)
 
     @property
     def num_structures(self) -> int:
@@ -308,9 +313,56 @@ def pack_structures(
         n_cap=n_cap,
         batch_size=b_slots,
         build_positions=[np.asarray(a.positions).copy() for a in structures],
+        cells=[np.asarray(a.cell, dtype=np.float64).copy()
+               for a in structures],
+        pbcs=[np.asarray(a.pbc).copy() for a in structures],
         stats=packed_stats(graph, B),
     )
     return graph, host
+
+
+def build_packed_refresh_spec(host: PackedHostData, graph: PartitionedGraph,
+                              r_build: float, dtype=np.float32):
+    """Spec for refreshing THIS packed graph's edges on device: per-block
+    dense search sized to the pack-time structures (see
+    ``neighbors.device.build_packed_spec``). ``r_build`` must be the pack
+    cutoff (cutoff + skin)."""
+    from ..neighbors.device import build_packed_spec
+
+    return build_packed_spec(
+        host.cells, host.pbcs, host.n_atoms, host.node_offsets, r_build,
+        graph.n_cap, graph.e_cap, dtype=dtype)
+
+
+def _device_refresh_packed(static, arrays, graph, positions):
+    """Packed-batch rebuild + in-place swap (traceable). ``positions``:
+    (1, N_cap, 3) packed input-frame coordinates."""
+    from ..neighbors.device import packed_neighbors
+    from .graph import refresh_edges
+
+    src, dst, off_cart, n_edges, overflow = packed_neighbors(
+        static, arrays, positions[0])
+    graph = refresh_edges(graph, src, dst, off_cart, n_edges)
+    return graph, n_edges, overflow
+
+
+_refresh_packed_jitted = None
+
+
+def device_refresh_packed(static, arrays, graph, positions):
+    """Jitted host entry for the packed device refresh — swaps rebuilt
+    block-diagonal edge arrays into an existing packed graph without
+    re-tracing (same bucket caps => same shapes)."""
+    global _refresh_packed_jitted
+    if _refresh_packed_jitted is None:
+        import jax
+
+        _refresh_packed_jitted = jax.jit(
+            _device_refresh_packed, static_argnums=0)
+    from ..neighbors.device import _as_device_arrays
+
+    return _refresh_packed_jitted(static, _as_device_arrays(arrays), graph,
+                                  positions)
 
 
 def packed_stats(graph: PartitionedGraph, n_real_structures: int) -> dict:

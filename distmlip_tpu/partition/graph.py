@@ -408,6 +408,91 @@ def build_partitioned_graph(
     return graph, host
 
 
+def refresh_edges(graph: PartitionedGraph, edge_src, edge_dst, edge_offset,
+                  n_edges) -> PartitionedGraph:
+    """In-place (shape-preserving) edge swap — traceable inside jit.
+
+    Swaps freshly built edge arrays (from ``neighbors.device``) into an
+    existing single-partition or packed ``PartitionedGraph`` without
+    changing any static field: same caps => same shapes => the enclosing
+    program never re-traces. Re-establishes the padding contract here so
+    both device kernels stay contract-free: padded slots are masked, their
+    ``dst`` repeats the last real value (nondecreasing, in-bounds), their
+    ``src``/``offset`` are zeroed.
+
+    Restrictions (checked at trace time — all static metadata): single
+    partition, unsplit edge layout (``e_split == e_cap``), no bond graph
+    (the line-graph arrays would go stale; bond-graph models keep the host
+    rebuild).
+    """
+    import jax.numpy as jnp
+
+    if graph.num_partitions != 1:
+        raise ValueError(
+            f"refresh_edges requires a single-partition graph (got "
+            f"P={graph.num_partitions}); multi-partition graphs rebuild on "
+            f"the host")
+    if graph.e_split != graph.e_cap:
+        raise ValueError(
+            "refresh_edges requires an unsplit edge layout "
+            f"(e_split={graph.e_split} != e_cap={graph.e_cap})")
+    if graph.has_bond_graph:
+        raise ValueError(
+            "refresh_edges cannot rebuild bond/line-graph arrays; "
+            "bond-graph models use the host rebuild path")
+    import dataclasses
+
+    e_cap = graph.e_cap
+    idx = jnp.arange(e_cap, dtype=jnp.int32)
+    mask = idx < n_edges
+    last = edge_dst[jnp.clip(n_edges - 1, 0, e_cap - 1)]
+    dst = jnp.where(mask, edge_dst, last).astype(graph.edge_dst.dtype)
+    src = jnp.where(mask, edge_src, 0).astype(graph.edge_src.dtype)
+    off = jnp.where(mask[:, None], edge_offset, 0).astype(
+        graph.edge_offset.dtype)
+    return dataclasses.replace(
+        graph,
+        edge_src=src[None],
+        edge_dst=dst[None],
+        edge_offset=off[None],
+        edge_mask=mask[None],
+    )
+
+
+def _device_refresh_single(static, arrays, graph, positions):
+    """Cell-list rebuild + in-place swap for a single-structure graph.
+
+    ``positions``: (1, N_cap, 3) input-frame coordinates. Returns
+    ``(graph', n_edges, overflow)``; on overflow the caller must discard
+    ``graph'`` and rebuild on the host with grown caps.
+    """
+    from ..neighbors.device import cell_list_neighbors
+
+    src, dst, off, n_edges, overflow = cell_list_neighbors(
+        static, arrays, positions[0])
+    graph = refresh_edges(graph, src, dst,
+                          off.astype(positions.dtype), n_edges)
+    return graph, n_edges, overflow
+
+
+_refresh_single_jitted = None
+
+
+def device_refresh_graph(static, arrays, graph, positions):
+    """Jitted host entry for the single-structure device refresh (one
+    executable per distinct spec static + graph shape bucket)."""
+    global _refresh_single_jitted
+    if _refresh_single_jitted is None:
+        import jax
+
+        _refresh_single_jitted = jax.jit(
+            _device_refresh_single, static_argnums=0)
+    from ..neighbors.device import _as_device_arrays
+
+    return _refresh_single_jitted(static, _as_device_arrays(arrays), graph,
+                                  positions)
+
+
 def graph_build_stats(graph: PartitionedGraph) -> dict:
     """Shape/occupancy/halo-volume stats from a host-side (numpy) graph.
 

@@ -553,7 +553,8 @@ class ServeEngine:
                                 if service_s > 0 else 0.0),
         )
         for k in ("bucket_key", "node_occupancy", "edge_occupancy",
-                  "padding_waste_frac", "n_atoms"):
+                  "padding_waste_frac", "n_atoms", "rebuild_count",
+                  "rebuild_on_device", "rebuild_overflow_count"):
             if pot_stats and k in pot_stats:
                 setattr(rec, k, pot_stats[k])
         tel.emit(rec)

@@ -26,6 +26,7 @@ PHASE_KEYS = (
     "neighbor_s",       # host neighbor-list build (excl. prefetch join)
     "partition_s",      # plan + pad + device_put (warm path: positions upload)
     "prefetch_wait_s",  # time spent joining an in-flight background build
+    "rebuild_s",        # on-device neighbor rebuild dispatch (no host FPIS)
     "device_s",         # jitted potential dispatch + result fetch
     "total_s",          # whole calculate()/chunk wall time
 )
@@ -81,6 +82,12 @@ class StepRecord:
     halo_send_per_part: list[int] = field(default_factory=list)
     halo_recv_per_part: list[int] = field(default_factory=list)
     bond_halo_send_per_part: list[int] = field(default_factory=list)
+
+    # --- neighbor rebuilds (device-resident rebuild, neighbors/device.py) ---
+    rebuild_count: int = 0           # graph (re)builds this step/chunk
+    rebuild_on_device: int = 0       # of those, rebuilt ON DEVICE (no host FPIS)
+    rebuild_overflow_count: int = 0  # cumulative device-capacity fallbacks
+    # per-rebuild latency rides timings["rebuild_s"] (phase table picks it up)
 
     # --- cache behavior ---
     graph_reused: bool = False       # skin cache hit (positions-only scatter)

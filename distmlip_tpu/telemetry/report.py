@@ -13,7 +13,11 @@ and flags the anomaly classes this repo has actually hit:
 - **halo imbalance** — max/mean per-partition halo send volume above
   ``imbalance_factor``: one partition's communication dominates, the slab
   decomposition needs rebalancing (arXiv:2504.10700's data-distribution
-  failure mode).
+  failure mode);
+- **host-rebuild dominant** — a device-rebuild-capable run (some rebuilds
+  DID run on device) that still pays most of its rebuilds on the host:
+  capacity overflows or structure churn are defeating the device-resident
+  path, so the hot loop keeps stalling on host FPIS rebuilds.
 """
 
 from __future__ import annotations
@@ -75,6 +79,14 @@ class Report:
         if "max_halo_imbalance" in c:
             out.append(f"halo send imbalance (max/mean over partitions): "
                        f"worst={c['max_halo_imbalance']:.2f}")
+        if c.get("rebuilds_total"):
+            n_dev = c.get("rebuilds_on_device", 0)
+            n_host = c["rebuilds_total"] - n_dev
+            ovf = c.get("rebuild_overflows", 0)
+            out.append(
+                f"rebuilds: total={c['rebuilds_total']} on_device={n_dev} "
+                f"host={n_host} overflow_fallbacks={ovf} "
+                f"(overflow rate {ovf / max(c['rebuilds_total'], 1):.1%})")
         if "halo_modes" in c or "collective_count" in c:
             bits = []
             if "halo_modes" in c:
@@ -193,6 +205,22 @@ def aggregate(
         c["max_mfu"] = max(mfus)
     c["prefetch_skipped_hbm"] = sum(
         getattr(r, "prefetch_skipped_hbm", False) for r in records)
+    # neighbor rebuilds: legacy records (pre-device-rebuild writers) carry
+    # rebuild_count == 0 even on rebuild steps — fall back to the bool
+    reb_total = sum(max(r.rebuild_count, int(r.rebuild)) for r in records)
+    if reb_total:
+        c["rebuilds_total"] = reb_total
+        c["rebuilds_on_device"] = sum(r.rebuild_on_device for r in records)
+        # rebuild_overflow_count is CUMULATIVE per producer; distinct
+        # producers emit distinct kinds (calculate / md_chunk /
+        # batched_calculate / serve_*), so sum the per-kind maxima — a
+        # plain max() across a shared sink would drop every producer but
+        # the largest
+        by_kind_max: dict[str, int] = {}
+        for r in records:
+            by_kind_max[r.kind] = max(by_kind_max.get(r.kind, 0),
+                                      r.rebuild_overflow_count)
+        c["rebuild_overflows"] = sum(by_kind_max.values())
 
     # --- batched engine: per-bucket table (shape-bucketed compile cache) ---
     by_bucket: dict[str, list[StepRecord]] = {}
@@ -287,6 +315,18 @@ def aggregate(
                 f"bucket {key}: mean occupancy {occ:.2f} over {b['steps']} "
                 f"step(s) below {occupancy_floor:.2f} — tune BucketPolicy "
                 f"growth/base or batch more structures per request"))
+    # host-rebuild-dominant: the run proved device-rebuild capability (at
+    # least one on-device rebuild) yet paid the majority of its rebuilds on
+    # the host — overflows or churn are defeating the device-resident path
+    n_dev = c.get("rebuilds_on_device", 0)
+    n_total = c.get("rebuilds_total", 0)
+    if n_dev > 0 and n_total >= 4 and (n_total - n_dev) > n_dev:
+        rep.anomalies.append(Anomaly(
+            "host_rebuild_dominant", 0,
+            f"{n_total - n_dev}/{n_total} rebuilds ran on the HOST in a "
+            f"device-rebuild-capable run ({c.get('rebuild_overflows', 0)} "
+            f"overflow fallback(s)) — grow capacities or check structure "
+            f"churn; the hot loop is stalling on host FPIS rebuilds"))
     for r in records:
         if r.halo_send_per_part and r.halo_imbalance() > imbalance_factor:
             rep.anomalies.append(Anomaly(

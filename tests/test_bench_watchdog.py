@@ -215,6 +215,46 @@ def test_canary_kill_escalates_through_sigterm_immune_canary():
         os.kill(out["t"]["canary_pid"], 0)
 
 
+def test_canary_wedge_reprobe_recovers(tmp_path):
+    # a wedged first canary (killed) must trigger ONE bounded re-probe
+    # with backoff; if the kill released the grant (the re-probe canary
+    # exits 0) the bench proceeds instead of declaring the backend
+    # unavailable for the whole round
+    marker = str(tmp_path / "first_canary_ran")
+    canary_src = (
+        f"import os, time\n"
+        f"m = {marker!r}\n"
+        "done = os.path.exists(m)\n"
+        "open(m, 'w').close()\n"
+        "time.sleep(0 if done else 120)\n")
+    r = _run_snippet(
+        "import os, json\n"
+        "os.environ['BENCH_CANARY_LOG'] = '/tmp/bench_canary_test.log'\n"
+        "os.environ['BENCH_CLAIM_TIMEOUT_S'] = '3'\n"
+        "os.environ['BENCH_RETRIES'] = '1'\n"
+        "os.environ['BENCH_CANARY_KILL_GRACE_S'] = '1'\n"
+        "os.environ['BENCH_WEDGE_REPROBE_TIMEOUT_S'] = '10'\n"
+        "import bench\n"
+        f"bench._CANARY_SRC = {canary_src!r}\n"
+        "w = bench._Watchdog()\n"
+        "ok, detail = bench._canary_claim(w)\n"
+        "w.finish()\n"
+        "print(json.dumps({'ok': ok, 't': bench._TELEMETRY}))\n",
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True
+    assert out["t"]["canary"] == "ok"
+    assert out["t"]["wedge_suspected"] is True   # the first probe wedged
+    assert out["t"]["wedge_reprobes"] == 1
+    # the wedged first canary must still be dead (no leaked pid)
+    import pytest
+
+    with pytest.raises(ProcessLookupError):
+        os.kill(out["t"]["canary_pid"], 0)
+
+
 def test_wedge_telemetry_present_on_watchdog_fire():
     # artifact JSON must carry the wedge fields on the watchdog path too
     r = _run_snippet(

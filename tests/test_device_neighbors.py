@@ -1,0 +1,530 @@
+"""Device-resident neighbor rebuild: cell-list parity vs the numpy FPIS
+reference across PBC edge cases, in-place graph refresh exactness, the
+device-resident DeviceMD loop (single program, no host callbacks, flat
+compile count across rebuilds), and overflow fallback robustness."""
+
+import numpy as np
+import pytest
+
+from distmlip_tpu import geometry
+from distmlip_tpu.neighbors import neighbor_list_numpy
+from distmlip_tpu.neighbors.device import (build_cell_list_spec,
+                                           build_packed_spec,
+                                           device_neighbor_list,
+                                           device_packed_neighbor_list)
+
+pytestmark = pytest.mark.device_neighbors
+
+
+def _ref_pairs(cart, lattice, pbc, r):
+    nl = neighbor_list_numpy(cart, lattice, pbc, r)
+    return set(zip(nl.src.tolist(), nl.dst.tolist(),
+                   map(tuple, nl.offsets.tolist())))
+
+
+def _dev_pairs(cart, lattice, pbc, r, n_cap=None, e_cap=8192):
+    n = len(cart)
+    n_cap = n_cap or n
+    pos = np.zeros((n_cap, 3), np.float32)
+    pos[:n] = cart
+    static, arrays = build_cell_list_spec(
+        lattice, pbc, r, n, n_cap, e_cap, positions=cart)
+    src, dst, off, n_edges, overflow = device_neighbor_list(
+        static, arrays, pos)
+    assert not bool(overflow)
+    ne = int(n_edges)
+    src = np.asarray(src)[:ne]
+    dst = np.asarray(dst)[:ne]
+    off = np.asarray(off)[:ne]
+    # graph contract: dst (the aggregation center) globally nondecreasing
+    assert np.all(np.diff(dst) >= 0)
+    return set(zip(src.tolist(), dst.tolist(), map(tuple, off.tolist())))
+
+
+# ---------------------------------------------------------------------------
+# parity vs neighbor_list_numpy (exact pair sets) — PBC edge-case suite
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_parity_cubic(rng):
+    lattice = np.eye(3) * 8.0
+    cart = rng.random((40, 3)) @ lattice
+    assert _ref_pairs(cart, lattice, [1, 1, 1], 3.0) == \
+        _dev_pairs(cart, lattice, [1, 1, 1], 3.0)
+
+
+@pytest.mark.tier1
+def test_parity_triclinic(rng):
+    """Strongly skewed (triclinic) lattice: plane-spacing grid sizing must
+    stay exact under skew."""
+    lattice = np.array([[8.0, 0, 0], [2.5, 7.0, 0], [1.5, -2.0, 6.5]])
+    cart = rng.random((30, 3)) @ lattice
+    assert _ref_pairs(cart, lattice, [1, 1, 1], 3.2) == \
+        _dev_pairs(cart, lattice, [1, 1, 1], 3.2)
+
+
+@pytest.mark.tier1
+def test_parity_tiny_cell_multi_image(rng):
+    """Cutoff > box: multi-image pairs (multi-wrap stencil reach) and an
+    atom neighboring its own periodic images."""
+    lattice = np.eye(3) * 2.0
+    cart = np.array([[0.5, 0.5, 0.5], [1.2, 0.4, 1.7]])
+    assert _ref_pairs(cart, lattice, [1, 1, 1], 2.9) == \
+        _dev_pairs(cart, lattice, [1, 1, 1], 2.9)
+
+
+@pytest.mark.tier1
+def test_parity_one_atom():
+    lattice = np.eye(3) * 2.0
+    cart = np.array([[0.5, 0.5, 0.5]])
+    pairs = _dev_pairs(cart, lattice, [1, 1, 1], 2.9)
+    assert pairs == _ref_pairs(cart, lattice, [1, 1, 1], 2.9)
+    assert len(pairs) > 0  # self-image neighbors exist
+
+
+def test_parity_partial_pbc_unwrapped(rng):
+    """Non-periodic axis + unwrapped (translated) inputs: offsets must be
+    reported relative to the input frame, no wrap on the open axis."""
+    lattice = np.array([[8.0, 0, 0], [2.5, 7.0, 0], [1.5, -2.0, 6.5]])
+    cart = rng.random((30, 3)) @ lattice
+    shift = rng.integers(-3, 4, (30, 3)) @ lattice
+    moved = cart + shift
+    assert _ref_pairs(moved, lattice, [1, 1, 0], 3.0) == \
+        _dev_pairs(moved, lattice, [1, 1, 0], 3.0)
+
+
+def test_parity_padded_rows(rng):
+    """Padded node rows (n_cap > n_atoms) must contribute no edges."""
+    lattice = np.eye(3) * 7.0
+    cart = rng.random((25, 3)) @ lattice
+    assert _ref_pairs(cart, lattice, [1, 1, 1], 2.8) == \
+        _dev_pairs(cart, lattice, [1, 1, 1], 2.8, n_cap=64)
+
+
+def test_parity_random_sweep():
+    for seed in range(5):
+        r = np.random.default_rng(seed)
+        n = int(r.integers(5, 70))
+        box = float(r.uniform(3.0, 10.0))
+        lattice = np.eye(3) * box
+        lattice[0, 1] = r.uniform(-0.3, 0.3) * box
+        lattice[1, 2] = r.uniform(-0.3, 0.3) * box
+        cart = r.random((n, 3)) @ lattice
+        cutoff = float(r.uniform(1.5, 3.5))
+        assert _ref_pairs(cart, lattice, [1, 1, 1], cutoff) == \
+            _dev_pairs(cart, lattice, [1, 1, 1], cutoff, e_cap=16384), seed
+
+
+@pytest.mark.tier1
+def test_packed_parity(rng):
+    """Block-diagonal packed batch: every block's device edges must equal
+    its own numpy reference (Cartesian-baked offsets, block-sorted dst)."""
+    structs = [
+        (rng.random((12, 3)) @ (np.eye(3) * 6.0), np.eye(3) * 6.0,
+         [1, 1, 1]),
+        (rng.random((7, 3)) @ np.array([[5.0, 0, 0], [1.2, 4.5, 0],
+                                        [0, 0.8, 4.8]]),
+         np.array([[5.0, 0, 0], [1.2, 4.5, 0], [0, 0.8, 4.8]]), [1, 1, 1]),
+        (np.array([[0.5, 0.5, 0.5]]), np.eye(3) * 2.0, [1, 1, 1]),
+    ]
+    r = 2.7
+    n_atoms = [len(c) for c, *_ in structs]
+    node_off = np.concatenate([[0], np.cumsum(n_atoms)])
+    n_cap, e_cap = 64, 4096
+    pos = np.zeros((n_cap, 3), np.float32)
+    for b, (c, *_) in enumerate(structs):
+        pos[node_off[b]:node_off[b + 1]] = c
+    static, arrays = build_packed_spec(
+        [s[1] for s in structs], [s[2] for s in structs], n_atoms, node_off,
+        r, n_cap, e_cap)
+    src, dst, off, n_edges, overflow = device_packed_neighbor_list(
+        static, arrays, pos)
+    assert not bool(overflow)
+    ne = int(n_edges)
+    src, dst, off = (np.asarray(src)[:ne], np.asarray(dst)[:ne],
+                     np.asarray(off)[:ne])
+    assert np.all(np.diff(dst) >= 0)
+    for b, (cart, lattice, pbc) in enumerate(structs):
+        nl = neighbor_list_numpy(cart, lattice, pbc, r)
+        ref = sorted(zip(nl.src.tolist(), nl.dst.tolist(),
+                         map(tuple, (nl.offsets @ lattice).round(3))))
+        sel = (dst >= node_off[b]) & (dst < node_off[b + 1])
+        got = sorted(zip((src[sel] - node_off[b]).tolist(),
+                         (dst[sel] - node_off[b]).tolist(),
+                         map(tuple, off[sel].astype(np.float64).round(3))))
+        assert len(ref) == len(got), b
+        for a, g in zip(ref, got):
+            assert a[0] == g[0] and a[1] == g[1], b
+            np.testing.assert_allclose(a[2], g[2], atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# overflow flags
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_edge_overflow_flag(rng):
+    lattice = np.eye(3) * 6.0
+    cart = rng.random((30, 3)) @ lattice
+    static, arrays = build_cell_list_spec(
+        lattice, [1, 1, 1], 3.0, 30, 30, 8, positions=cart)  # e_cap=8: tiny
+    src, dst, off, n_edges, overflow = device_neighbor_list(
+        static, arrays, cart.astype(np.float32))
+    assert bool(overflow)
+    # the COUNT still reports the true need so the host can grow the cap
+    assert int(n_edges) == len(_ref_pairs(cart, lattice, [1, 1, 1], 3.0))
+
+
+@pytest.mark.tier1
+def test_cell_overflow_flag(rng):
+    lattice = np.eye(3) * 6.0
+    cart = rng.random((30, 3)) @ lattice
+    static, arrays = build_cell_list_spec(
+        lattice, [1, 1, 1], 3.0, 30, 30, 8192, positions=cart, cell_cap=1)
+    *_rest, overflow = device_neighbor_list(
+        static, arrays, cart.astype(np.float32))
+    assert bool(overflow)
+
+
+# ---------------------------------------------------------------------------
+# in-place refresh: padding contract + exactness through a potential
+# ---------------------------------------------------------------------------
+
+
+def _lj_setup(rng, reps=(3, 3, 3), skin=0.5, cutoff=3.0):
+    from distmlip_tpu.calculators import Atoms
+
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5],
+                     [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.8, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, 0.03, (len(frac), 3))
+    atoms = Atoms(numbers=np.full(len(cart), 14), positions=cart,
+                  cell=lattice)
+    from distmlip_tpu.models import PairConfig, PairPotential
+
+    model = PairPotential(PairConfig(cutoff=cutoff, kind="lj"))
+    params = {"eps": np.float32(0.05), "sigma": np.float32(2.0)}
+    return atoms, model, params
+
+
+@pytest.mark.tier1
+def test_refresh_contract_and_exactness(rng):
+    """refresh_edges must re-establish the full padding contract and the
+    refreshed graph must reproduce a from-scratch host rebuild's energy/
+    forces/stress to fp32 roundoff."""
+    import jax.numpy as jnp
+
+    from distmlip_tpu.neighbors import neighbor_list_numpy as nln
+    from distmlip_tpu.parallel import make_potential_fn
+    from distmlip_tpu.partition import (CapacityPolicy, build_plan,
+                                        build_partitioned_graph,
+                                        device_refresh_graph)
+
+    atoms, model, params = _lj_setup(rng)
+    r = 3.0
+    caps = CapacityPolicy()
+    nl = nln(atoms.positions, atoms.cell, atoms.pbc, r)
+    plan = build_plan(nl, atoms.cell, atoms.pbc, 1, r)
+    graph, host = build_partitioned_graph(
+        plan, nl, np.full(len(atoms), 14, np.int32), atoms.cell, caps=caps)
+    static, arrays = build_cell_list_spec(
+        atoms.cell, atoms.pbc, r, len(atoms), graph.n_cap, graph.e_cap,
+        positions=atoms.positions)
+    drift = atoms.positions + rng.normal(0, 0.25, atoms.positions.shape)
+    pos = jnp.asarray(host.scatter_global(drift.astype(np.float32),
+                                          graph.n_cap))
+    graph2, n_edges, overflow = device_refresh_graph(
+        static, arrays, graph, pos)
+    assert not bool(overflow)
+    ne = int(n_edges)
+    edge_dst = np.asarray(graph2.edge_dst[0])
+    edge_mask = np.asarray(graph2.edge_mask[0])
+    assert edge_mask.sum() == ne
+    assert np.all(np.diff(edge_dst) >= 0)          # globally nondecreasing
+    assert np.all(edge_dst[ne:] == edge_dst[ne - 1])  # repeat-last padding
+    assert np.all(np.asarray(graph2.edge_src[0])[ne:] == 0)
+
+    pot = make_potential_fn(model.energy_fn, None)
+    out_dev = pot(params, graph2, pos)
+    nl2 = nln(drift, atoms.cell, atoms.pbc, r)
+    plan2 = build_plan(nl2, atoms.cell, atoms.pbc, 1, r)
+    graph3, host3 = build_partitioned_graph(
+        plan2, nl2, np.full(len(atoms), 14, np.int32), atoms.cell, caps=caps)
+    out_host = pot(params, graph3, graph3.positions)
+    assert abs(float(out_dev["energy"]) - float(out_host["energy"])) < 1e-5
+    f_dev = host.gather_owned(np.asarray(out_dev["forces"]), len(atoms))
+    f_host = host3.gather_owned(np.asarray(out_host["forces"]), len(atoms))
+    np.testing.assert_allclose(f_dev, f_host, atol=1e-5)
+
+
+def test_refresh_rejects_unsupported_graphs(rng):
+    """Bond graphs and frontier-split layouts must refuse the in-place
+    swap loudly (their auxiliary arrays would go stale)."""
+    import jax.numpy as jnp
+
+    from distmlip_tpu.neighbors import neighbor_list_numpy as nln
+    from distmlip_tpu.partition import (build_plan, build_partitioned_graph,
+                                        refresh_edges)
+
+    atoms, *_ = _lj_setup(rng)
+    nl = nln(atoms.positions, atoms.cell, atoms.pbc, 3.0, bond_r=2.0)
+    plan = build_plan(nl, atoms.cell, atoms.pbc, 1, 3.0, 2.0,
+                      use_bond_graph=True)
+    graph, _host = build_partitioned_graph(
+        plan, nl, np.full(len(atoms), 14, np.int32), atoms.cell)
+    z = jnp.zeros((graph.e_cap,), jnp.int32)
+    with pytest.raises(ValueError, match="bond"):
+        refresh_edges(graph, z, z, jnp.zeros((graph.e_cap, 3)), 0)
+
+
+# ---------------------------------------------------------------------------
+# DistPotential / BatchedPotential integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_distpotential_device_refresh_parity(rng):
+    """Skin-cache invalidations on a single-partition potential must be
+    served ON DEVICE and match the host-rebuild potential step for step."""
+    from distmlip_tpu.calculators import DistPotential
+
+    atoms, model, params = _lj_setup(rng)
+    pot_dev = DistPotential(model, params, num_partitions=1, skin=0.5)
+    pot_host = DistPotential(model, params, num_partitions=1, skin=0.5,
+                             device_rebuild=False)
+    a1, a2 = atoms.copy(), atoms.copy()
+    for _ in range(4):
+        r1 = pot_dev.calculate(a1)
+        r2 = pot_host.calculate(a2)
+        assert abs(r1["energy"] - r2["energy"]) < 1e-5
+        np.testing.assert_allclose(r1["forces"], r2["forces"], atol=1e-4)
+        np.testing.assert_allclose(r1["stress"], r2["stress"], atol=1e-5)
+        step = rng.normal(0, 0.12, a1.positions.shape)
+        a1.positions = a1.positions + step
+        a2.positions = a2.positions + step
+    assert pot_dev.rebuild_on_device_count >= 2
+    assert pot_host.rebuild_on_device_count == 0
+    # the device refresh leaves no host FPIS time in the phase breakdown
+    assert pot_dev.last_timings["neighbor_s"] < 0.005
+    assert "rebuild_s" in pot_dev.last_timings
+
+
+def test_env_kill_switch(rng, monkeypatch):
+    from distmlip_tpu.calculators import DistPotential
+
+    monkeypatch.setenv("DISTMLIP_DEVICE_REBUILD", "0")
+    atoms, model, params = _lj_setup(rng)
+    pot = DistPotential(model, params, num_partitions=1, skin=0.5)
+    a = atoms.copy()
+    for _ in range(3):
+        pot.calculate(a)
+        a.positions = a.positions + rng.normal(0, 0.2, a.positions.shape)
+    assert pot.rebuild_on_device_count == 0
+    assert pot.rebuild_count >= 2  # host rebuilds served the invalidations
+
+
+@pytest.mark.tier1
+def test_batched_device_refresh_parity(rng):
+    """Packed-batch invalidations (same structure list, drifted positions)
+    refresh on device and match a rebuild-every-call reference, with zero
+    extra executables."""
+    from distmlip_tpu.calculators import Atoms, BatchedPotential
+
+    atoms, model, params = _lj_setup(rng, reps=(2, 2, 2))
+    tiny = Atoms(numbers=np.array([14]),
+                 positions=np.array([[0.5, 0.5, 0.5]]), cell=np.eye(3) * 2.5)
+    structs = [atoms, tiny]
+    bp = BatchedPotential(model, params, skin=0.4)
+    bp_ref = BatchedPotential(model, params, skin=0.0, device_rebuild=False)
+    bp.calculate(structs)
+    compiles_before = bp.compile_count
+    for _ in range(3):
+        for a in structs:
+            a.positions = a.positions + rng.normal(0, 0.15,
+                                                   a.positions.shape)
+        r1 = bp.calculate(structs)
+        r2 = bp_ref.calculate(structs)
+        for b in range(len(structs)):
+            assert abs(r1[b]["energy"] - r2[b]["energy"]) < 2e-5
+            np.testing.assert_allclose(r1[b]["forces"], r2[b]["forces"],
+                                       atol=1e-4)
+    assert bp.rebuild_on_device_count >= 2
+    assert bp.compile_count == compiles_before  # refresh never recompiles
+
+
+# ---------------------------------------------------------------------------
+# DeviceMD: device-resident trajectories
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_device_md_in_loop_rebuild_matches_host(rng):
+    """A trajectory whose skin invalidations are rebuilt IN-LOOP on device
+    must match the host-rebuild DeviceMD trajectory, complete all steps in
+    ONE chunk dispatch, and never grow the stepper's executable cache."""
+    from distmlip_tpu.calculators import DeviceMD, DistPotential
+
+    atoms, model, params = _lj_setup(rng)
+    atoms.set_maxwell_boltzmann_velocities(300.0,
+                                           rng=np.random.default_rng(7))
+    a_dev, a_host = atoms.copy(), atoms.copy()
+
+    pot_dev = DistPotential(model, params, num_partitions=1, skin=0.4)
+    md_dev = DeviceMD(pot_dev, a_dev, timestep=1.0)
+    assert md_dev.device_rebuild
+    md_dev.run(40)
+
+    pot_host = DistPotential(model, params, num_partitions=1, skin=0.4,
+                             device_rebuild=False)
+    md_host = DeviceMD(pot_host, a_host, timestep=1.0,
+                       device_rebuild=False)
+    md_host.run(40)
+
+    assert md_dev.steps_done == 40 and md_host.steps_done == 40
+    assert md_dev.rebuilds_on_device >= 1     # the skin DID fire in-loop
+    assert md_host.rebuilds >= 2              # ... and on host in the A/B
+    np.testing.assert_allclose(a_dev.positions, a_host.positions, atol=1e-3)
+    np.testing.assert_allclose(a_dev.velocities, a_host.velocities,
+                               atol=1e-3)
+    # compile count stays flat across rebuilds: one chunk executable
+    assert md_dev._dev_stepper._cache_size() == 1
+
+
+@pytest.mark.tier1
+def test_device_md_chunk_is_single_device_program(rng):
+    """Trace-level acceptance: a chunk containing skin-triggered rebuilds
+    lowers to one device program — the rebuild (sort-based binning) sits
+    INSIDE the while loop and there is no host callback anywhere."""
+    import jax
+    import jax.numpy as jnp
+
+    from distmlip_tpu.calculators import DeviceMD, DistPotential
+    from distmlip_tpu.parallel.audit import (count_host_callbacks,
+                                             count_primitives)
+
+    atoms, model, params = _lj_setup(rng)
+    pot = DistPotential(model, params, num_partitions=1, skin=0.4)
+    md = DeviceMD(pot, atoms, timestep=1.0)
+    graph, host, positions = pot._prepare(atoms)
+    md._ensure_spec(graph)
+    dtype = np.asarray(graph.lattice).dtype
+    ref = host.scatter_global(pot._cache[3].astype(dtype), graph.n_cap)
+    vel = host.scatter_global(atoms.velocities.astype(dtype), graph.n_cap)
+    masses = host.scatter_global(atoms.masses.astype(dtype), graph.n_cap,
+                                 fill=1.0)
+    jaxpr = jax.make_jaxpr(md._dev_stepper)(
+        pot.params, graph, positions, ref, vel, masses, jnp.int32(8),
+        jnp.float32(0.0), jnp.float32(0.0))
+    assert not count_host_callbacks(jaxpr), count_host_callbacks(jaxpr)
+    prims = count_primitives(jaxpr, {"while", "sort"})
+    assert prims["while"] >= 1   # the chunk loop
+    assert prims["sort"] >= 1    # the in-loop cell-list binning
+
+
+def test_device_md_overflow_falls_back_and_continues(rng):
+    """A device-capacity bust mid-trajectory must fall back to the host
+    rebuild with grown caps, count the overflow, and preserve trajectory
+    continuity (all steps complete, same physics as the clean run)."""
+    from distmlip_tpu.calculators import DeviceMD, DistPotential
+
+    atoms, model, params = _lj_setup(rng)
+    atoms.set_maxwell_boltzmann_velocities(300.0,
+                                           rng=np.random.default_rng(9))
+    a_ovf, a_clean = atoms.copy(), atoms.copy()
+
+    pot_o = DistPotential(model, params, num_partitions=1, skin=0.4,
+                          device_rebuild=False)  # DeviceMD drives the spec
+    # explicit True overrides the potential's opt-out ("auto" would inherit)
+    md_o = DeviceMD(pot_o, a_ovf, timestep=1.0, device_rebuild=True,
+                    cell_capacity=1)
+    md_o.run(40)
+    assert md_o.steps_done == 40
+    assert md_o.rebuild_overflows >= 1
+    # the fallback grew the cell capacity, so later rebuilds succeeded
+    assert md_o._cell_cap_floor > 1 or md_o.rebuilds_on_device == 0
+
+    pot_c = DistPotential(model, params, num_partitions=1, skin=0.4,
+                          device_rebuild=False)
+    md_c = DeviceMD(pot_c, a_clean, timestep=1.0)
+    md_c.run(40)
+    np.testing.assert_allclose(a_ovf.positions, a_clean.positions,
+                               atol=2e-3)
+    # energy drift unchanged: both runs end at the same total energy scale
+    e_o = md_o.results["energy"] + md_o.results["kinetic"]
+    e_c = md_c.results["energy"] + md_c.results["kinetic"]
+    assert abs(e_o - e_c) < 5e-3
+
+
+def test_device_md_multi_partition_keeps_host_path(rng):
+    """P > 1 potentials cannot refresh in place — DeviceMD must silently
+    keep the host-rebuild chunk loop (no behavior change)."""
+    from distmlip_tpu.calculators import DeviceMD, DistPotential
+
+    atoms, model, params = _lj_setup(rng)
+    pot = DistPotential(model, params, num_partitions=2, skin=0.5)
+    md = DeviceMD(pot, atoms, timestep=1.0)
+    assert not md.device_rebuild
+    md.run(10)
+    assert md.steps_done == 10
+    assert md.rebuilds_on_device == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry: rebuild counters flow to records and the report
+# ---------------------------------------------------------------------------
+
+
+def test_device_md_rebuild_telemetry(rng):
+    from distmlip_tpu.calculators import DeviceMD, DistPotential
+    from distmlip_tpu.telemetry import Telemetry
+    from distmlip_tpu.telemetry.sinks import TelemetrySink
+
+    class Capture(TelemetrySink):
+        def __init__(self):
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+    atoms, model, params = _lj_setup(rng)
+    cap = Capture()
+    pot = DistPotential(model, params, num_partitions=1, skin=0.4,
+                        telemetry=Telemetry([cap]))
+    md = DeviceMD(pot, atoms, timestep=1.0)
+    md.run(40)
+    chunks = [r for r in cap.records if r.kind == "md_chunk"]
+    assert chunks
+    assert sum(r.rebuild_on_device for r in chunks) == md.rebuilds_on_device
+    assert sum(r.rebuild_count for r in chunks) >= md.rebuilds_on_device
+
+
+def test_report_rebuild_line_and_host_dominant_anomaly():
+    from distmlip_tpu.telemetry.record import StepRecord
+    from distmlip_tpu.telemetry.report import aggregate
+
+    recs = [
+        StepRecord(step=1, kind="md_chunk", rebuild=True, rebuild_count=4,
+                   rebuild_on_device=1, rebuild_overflow_count=2,
+                   timings={"total_s": 1.0, "rebuild_s": 0.01}),
+        StepRecord(step=2, kind="md_chunk", rebuild=True, rebuild_count=2,
+                   rebuild_on_device=1, rebuild_overflow_count=2,
+                   timings={"total_s": 1.0}),
+    ]
+    rep = aggregate(recs)
+    assert rep.counters["rebuilds_total"] == 6
+    assert rep.counters["rebuilds_on_device"] == 2
+    assert rep.counters["rebuild_overflows"] == 2
+    text = rep.render()
+    assert "rebuilds: total=6 on_device=2 host=4" in text
+    assert any(a.kind == "host_rebuild_dominant" for a in rep.anomalies)
+    # a device-dominant run must NOT flag
+    ok = [StepRecord(step=1, kind="md_chunk", rebuild=True, rebuild_count=5,
+                     rebuild_on_device=5, timings={"total_s": 1.0})]
+    assert not [a for a in aggregate(ok).anomalies
+                if a.kind == "host_rebuild_dominant"]
+    # legacy records (no rebuild_count) still fold into the total
+    legacy = [StepRecord(step=1, rebuild=True, timings={"total_s": 1.0})]
+    assert aggregate(legacy).counters["rebuilds_total"] == 1

@@ -522,6 +522,75 @@ def _main_measured():
         except Exception as e:  # noqa: BLE001 - serving is additive
             serve_extras["serve_error"] = f"{type(e).__name__}: {e}"[:160]
 
+    class _MeshPhaseSkipped(Exception):
+        """No configured mesh placement fits this host's device count."""
+
+    # 2-D mesh placements: structures/sec for ONE batch of structures
+    # across (batch x spatial) placements at EQUAL chip count — e.g. on 8
+    # chips, 8x1 (pure batch-parallel), 4x2 and 2x4 (each structure
+    # spatially split over 2/4 slabs with halo exchange on the spatial
+    # axis). Per-step StepRecords (mesh_shape/spatial_parts fields) ride
+    # the shared telemetry sinks. BENCH_MESH=0 skips.
+    mesh_extras = {}
+    if os.environ.get("BENCH_MESH", "1") != "0":
+        m_budget = float(os.environ.get("BENCH_MESH_TIMEOUT_S", "900"))
+        watchdog.phase(
+            f"mesh placement measurement exceeded {m_budget:.0f}s", m_budget)
+        try:
+            from distmlip_tpu.calculators import BatchedPotential
+            from distmlip_tpu.parallel import device_mesh
+            from distmlip_tpu.partition import BucketPolicy
+
+            n_dev = len(jax.devices())
+            placements = []
+            for spec in os.environ.get("BENCH_MESH_PLACEMENTS",
+                                       "8,1;4,2;2,4").split(";"):
+                b_m, s_m = (int(x) for x in spec.split(","))
+                if b_m * s_m <= n_dev:
+                    placements.append((b_m, s_m))
+            if not placements:
+                # its own key, distinct from BENCH_MESH=0 (no mesh_* keys
+                # at all) and from mesh_error (a genuine failure): no
+                # configured placement fits this host's device count
+                mesh_extras["mesh_skipped"] = (
+                    f"no placement in BENCH_MESH_PLACEMENTS fits "
+                    f"{n_dev} device(s)")
+                raise _MeshPhaseSkipped
+            m_steps = int(os.environ.get("BENCH_MESH_STEPS", "3"))
+            n_struct = int(os.environ.get("BENCH_MESH_STRUCTURES", "8"))
+            m_skin = float(os.environ.get("BENCH_SKIN", "0.5"))
+            s_max = max((s for _b, s in placements), default=1)
+            # slab rule: per-slab width must exceed 2x the build cutoff,
+            # so the shared structure pool is sized for the LARGEST S
+            r_build = float(model.cfg.cutoff) + m_skin
+            reps_x = max(int(np.ceil(2.0 * s_max * r_build / 3.9)) + 1, 4)
+            frac_m, lat_m = geometry.make_supercell(
+                unit, np.eye(3) * 3.9, (reps_x, 2, 2))
+            structs_m = []
+            for _ in range(n_struct):
+                cart_m = geometry.frac_to_cart(frac_m, lat_m) + \
+                    rng.normal(0, 0.04, (len(frac_m), 3))
+                structs_m.append(Atoms(numbers=np.full(len(cart_m), 14),
+                                       positions=cart_m, cell=lat_m))
+            for b_m, s_m in placements:
+                mpot = BatchedPotential(
+                    pot.model, pot.params, caps=BucketPolicy(), skin=m_skin,
+                    mesh=device_mesh(b_m, s_m), telemetry=telemetry)
+                mpot.calculate(structs_m)  # compile + first pack
+                t0 = time.perf_counter()
+                for _ in range(m_steps):
+                    for a in structs_m:
+                        a.positions += rng.normal(0, 0.01, a.positions.shape)
+                    mpot.calculate(structs_m)
+                dt_m = (time.perf_counter() - t0) / max(m_steps, 1)
+                mesh_extras[f"mesh_structs_per_sec_{b_m}x{s_m}"] = round(
+                    n_struct / dt_m, 2)
+            mesh_extras["mesh_atoms_per_structure"] = len(frac_m)
+        except _MeshPhaseSkipped:
+            pass  # mesh_skipped already recorded
+        except Exception as e:  # noqa: BLE001 - mesh phase is additive
+            mesh_extras["mesh_error"] = f"{type(e).__name__}: {e}"[:160]
+
     # device-resident MD: steps/sec through DeviceMD with the neighbor
     # rebuild ON DEVICE (in-loop cell list, zero host syncs) vs the host
     # FPIS rebuild at EQUAL skin, plus a rebuilds/sec microbench of the
@@ -619,7 +688,7 @@ def _main_measured():
     # its A/B counterpart (host-side jaxpr traces — no device work), plus
     # the analytic-FLOP mfu for the measured steps
     extras = {"halo_mode": halo_mode, **batched_extras, **serve_extras,
-              **dmd_extras}
+              **mesh_extras, **dmd_extras}
     try:
         from distmlip_tpu.parallel import make_potential_fn
         from distmlip_tpu.parallel.audit import count_collectives

@@ -60,6 +60,13 @@ class BatchedPotential:
     re-upload, no host repack, no recompile. A capacity overflow falls back
     to the host repack (which may move to the next bucket rung);
     ``DISTMLIP_DEVICE_REBUILD=0`` disables globally.
+
+    ``mesh`` (a ``parallel.device_mesh(batch, spatial)``): run the batch on
+    a 2-D (batch x spatial) mesh — structures spread over the batch axis
+    AND each structure spatially partitions into ``spatial`` slabs with
+    halo exchange on the spatial axis only. The single-device behavior
+    (mesh=None) is unchanged. On-device packed refresh is host-side only
+    for mesh placements (multi-partition graphs repack on the host).
     """
 
     def __init__(
@@ -73,12 +80,20 @@ class BatchedPotential:
         skin: float = 0.0,
         num_threads: int | None = None,
         device_rebuild: bool | str = "auto",
+        mesh=None,
         telemetry=None,
     ):
         self.model = model
         self.params = params
         self.species_map = species_map
         self.caps = caps or BucketPolicy()
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel import mesh_shape
+
+            self.batch_parts, self.spatial_parts = mesh_shape(mesh)
+        else:
+            self.batch_parts = self.spatial_parts = 1
         self.cutoff = float(model.cfg.cutoff)
         self.bond_cutoff = float(getattr(model.cfg, "bond_cutoff", 0.0))
         self.use_bond_graph = bool(getattr(model.cfg, "use_bond_graph", False))
@@ -95,10 +110,15 @@ class BatchedPotential:
         self._potential = make_batched_potential_fn(
             model.energy_and_aux_fn if self.compute_magmom
             else model.energy_fn,
-            compute_stress=self.compute_stress, aux=self.compute_magmom)
+            compute_stress=self.compute_stress, aux=self.compute_magmom,
+            mesh=self.mesh)
         self._cache = None  # (graph, host, [(numbers, cell, pbc)])
         self.rebuild_count = 0
-        # device-resident packed refresh (partition.device_refresh_packed)
+        # device-resident packed refresh (partition.device_refresh_packed);
+        # mesh placements repack on the host (the in-place edge swap is
+        # single-partition only)
+        if mesh is not None:
+            device_rebuild = False
         self.device_rebuild = (True if device_rebuild == "auto"
                                else bool(device_rebuild))
         self.rebuild_on_device_count = 0
@@ -167,6 +187,36 @@ class BatchedPotential:
         return (self.device_rebuild and self.skin > 0.0
                 and not self.use_bond_graph and device_rebuild_enabled())
 
+    def _graph_shardings(self, graph):
+        """NamedSharding pytree for a mesh-packed graph (None mesh: default
+        placement)."""
+        from ..parallel.runtime import graph_shardings
+
+        if self.mesh is None:
+            return None
+        return graph_shardings(self.mesh, graph)
+
+    def _put_positions(self, host, structures, dtype):
+        """Pack + upload positions with the mesh row sharding (or default
+        placement on the single-device path)."""
+        import jax
+        import jax.numpy as jnp
+
+        packed = host.scatter_positions(
+            [a.positions.astype(dtype) for a in structures], dtype=dtype)
+        if self.mesh is None:
+            # jnp.asarray so BOTH paths (host scatter / device refresh)
+            # hand the potential identically-placed arrays — mixed
+            # numpy/Array inputs would split the jit cache in two
+            return jnp.asarray(packed)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.mesh import mesh_row_axes
+
+        return jax.device_put(
+            packed, NamedSharding(self.mesh,
+                                  PartitionSpec(mesh_row_axes(self.mesh))))
+
     def _build(self, structures):
         import jax
 
@@ -175,9 +225,11 @@ class BatchedPotential:
                 structures, self.cutoff, self.bond_cutoff,
                 self.use_bond_graph, caps=self.caps,
                 species_fn=self._species, skin=self.skin,
-                num_threads=self.num_threads)
+                num_threads=self.num_threads,
+                spatial_parts=self.spatial_parts,
+                batch_parts=self.batch_parts)
         with annotate("distmlip/graph_upload"):
-            graph = jax.device_put(graph)
+            graph = jax.device_put(graph, self._graph_shardings(graph))
         self.rebuild_count += 1
         # refresh spec is built LAZILY on the first refresh attempt: a
         # churning structure stream (every serving batch different) would
@@ -271,24 +323,27 @@ class BatchedPotential:
                         for a in structures])
         t1 = time.perf_counter()
         if positions is None:
-            import jax.numpy as jnp
-
             dtype = np.asarray(graph.lattice).dtype
             with annotate("distmlip/positions_upload"):
-                # jnp.asarray so BOTH paths (host scatter / device refresh)
-                # hand the potential identically-placed arrays — mixed
-                # numpy/Array inputs would split the jit cache in two
-                positions = jnp.asarray(host.scatter_positions(
-                    [a.positions.astype(dtype) for a in structures],
-                    dtype=dtype))
+                positions = self._put_positions(host, structures, dtype)
         t2 = time.perf_counter()
         with annotate("distmlip/batched_potential"):
             out = self._potential(self.params, graph, positions)
-            energies = np.asarray(out["energies"], dtype=np.float64)
+            # flat shard-major slots -> input structure order (identity for
+            # the single-shard pack)
+            slots = host.structure_slots
+            energies = np.asarray(out["energies"],
+                                  dtype=np.float64)[slots]
         forces = host.gather_per_structure(np.asarray(out["forces"]))
-        strain_grad = np.asarray(out["strain_grad"])
-        magmoms = (host.gather_per_structure(np.asarray(
-            out["aux"]["magmoms"])[None]) if "aux" in out else None)
+        strain_grad = np.asarray(out["strain_grad"])[slots]
+        if "aux" in out:
+            m = np.asarray(out["aux"]["magmoms"])
+            # the meshless runtime returns shard-local (N_cap,) aux rows;
+            # the mesh runtime returns the packed (P, N_cap, ...) layout
+            magmoms = host.gather_per_structure(
+                m if self.mesh is not None else m[None])
+        else:
+            magmoms = None
         results = []
         for b in range(len(structures)):
             stress = strain_grad[b] / max(host.volumes[b], 1e-30)

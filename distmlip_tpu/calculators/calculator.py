@@ -33,6 +33,7 @@ An ASE ``Calculator`` adapter is provided when ASE is importable.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -237,6 +238,14 @@ class DistPotential:
         self._cache = None  # (graph, host, positions_sharding, build_pos,
                             #  numbers, cell, pbc, system)
         self.last_timings: dict[str, float] = {}
+        # serializes calculate() across threads (ServeEngine fallback lane
+        # + direct callers share one potential; see BatchedPotential)
+        self._lock = threading.RLock()
+        # graph-shape/occupancy stats of the LAST calculate() — the same
+        # surface BatchedPotential exposes, so a serving engine can emit
+        # uniform telemetry whichever lane (batched / spatial) served the
+        # request
+        self.last_stats: dict = {}
         # graphs actually USED by a calculate() — synchronous builds plus
         # ADOPTED background prefetches and on-device refreshes (all
         # incremented on the main thread); discarded speculative builds
@@ -361,19 +370,14 @@ class DistPotential:
 
     def _graph_shardings(self, graph):
         import jax
-        from jax.sharding import (NamedSharding, PartitionSpec,
-                                  SingleDeviceSharding)
+        from jax.sharding import SingleDeviceSharding
 
-        from ..parallel.runtime import graph_in_specs
+        from ..parallel.runtime import graph_shardings
 
         if self.mesh is None:
             dev = jax.devices()[0]
             return jax.tree.map(lambda _: SingleDeviceSharding(dev), graph)
-        specs = graph_in_specs(graph)
-        return jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, PartitionSpec),
-        )
+        return graph_shardings(self.mesh, graph)
 
     def ensure_runtime(self, atoms: Atoms) -> None:
         """Resolve AUTO partitioning (num_partitions=None) against this
@@ -714,7 +718,15 @@ class DistPotential:
         return graph, host, positions
 
     def calculate(self, atoms: Atoms) -> dict:
-        """Energy (eV), forces (eV/Å), stress (eV/Å^3, ASE sign convention)."""
+        """Energy (eV), forces (eV/Å), stress (eV/Å^3, ASE sign convention).
+
+        Thread-safe: callers sharing one potential (a ServeEngine lane plus
+        a direct caller) serialize here, and ``last_stats``/``last_timings``
+        always describe the caller's own step while the lock is held."""
+        with self._lock:
+            return self._calculate_locked(atoms)
+
+    def _calculate_locked(self, atoms: Atoms) -> dict:
         t_start = time.perf_counter()
         graph, host, positions = self._prepare(atoms)
         t2 = time.perf_counter()
@@ -742,6 +754,13 @@ class DistPotential:
                 m = np.asarray(self._site_fn(self.params, graph, positions))
             result["magmoms"] = host.gather_owned(m, len(atoms))
         self.last_timings["device_s"] = time.perf_counter() - t2
+        self.last_stats = dict(getattr(host, "stats", None) or {})
+        self.last_stats.update(
+            rebuild_count=int(self._prepare_flags.get("rebuild", False)),
+            rebuild_on_device=int(
+                self._prepare_flags.get("rebuild_on_device", 0)),
+            rebuild_overflow_count=self.rebuild_overflow_count,
+        )
         self._emit_record("calculate", host,
                           total_s=time.perf_counter() - t_start)
         return result

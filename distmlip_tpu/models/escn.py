@@ -339,6 +339,12 @@ class ESCN:
             count = jax.ops.segment_sum(
                 owned[:, 0], lg.struct_id, num_segments=B,
                 indices_are_sorted=True)                       # (B,)
+            # 2-D mesh placement (B x S): each spatial slab owns only part
+            # of every structure — reduce the composition over the spatial
+            # ring so the gate stays psum-consistent across a structure's
+            # slabs (identity when the graph is not spatially partitioned)
+            comp_sum = lg.psum(comp_sum)
+            count = lg.psum(count)
             gate_in = jnp.concatenate(
                 [comp_sum / jnp.maximum(count, 1.0)[:, None],
                  jnp.broadcast_to(csd, (B,) + csd.shape)], axis=-1)

@@ -1,13 +1,19 @@
-from .mesh import (GRAPH_AXIS, ensure_latency_hiding_flags, graph_mesh,
-                   latency_hiding_flags)
+from .mesh import (BATCH_AXIS, GRAPH_AXIS, SPATIAL_AXIS, device_mesh,
+                   ensure_latency_hiding_flags, graph_mesh,
+                   latency_hiding_flags, mesh_shape)
 from .halo import HALO_MODES, LocalGraph, local_graph_from_stacked
 from .runtime import (make_total_energy, make_potential_fn,
                       make_batched_potential_fn, make_site_fn,
-                      graph_in_specs)
-from .audit import collective_counts, count_collectives, ppermutes_by_scope
+                      graph_in_specs, graph_row_axes)
+from .audit import (collective_counts, collectives_by_axis,
+                    count_collectives, ppermutes_by_scope)
 
 __all__ = [
+    "BATCH_AXIS",
+    "SPATIAL_AXIS",
     "GRAPH_AXIS",
+    "device_mesh",
+    "mesh_shape",
     "graph_mesh",
     "latency_hiding_flags",
     "ensure_latency_hiding_flags",
@@ -19,7 +25,9 @@ __all__ = [
     "make_batched_potential_fn",
     "make_site_fn",
     "graph_in_specs",
+    "graph_row_axes",
     "collective_counts",
+    "collectives_by_axis",
     "count_collectives",
     "ppermutes_by_scope",
 ]

@@ -102,6 +102,61 @@ def count_primitives(closed_jaxpr, names) -> Counter:
     return counts
 
 
+def _eqn_axis_names(eqn) -> tuple:
+    """Mesh axis names a collective eqn operates over, from its params.
+
+    Collective primitives carry the axis under different param names across
+    primitives and jax versions (``axis_name`` for ppermute/all_gather,
+    ``axes`` for psum/pmax, sometimes ``axis_index_groups`` alongside);
+    values may be a single name or a tuple. Returns ``("<unknown>",)`` when
+    no axis metadata is present.
+    """
+    for key in ("axis_name", "axes", "named_axes"):
+        val = eqn.params.get(key)
+        if val is None:
+            continue
+        if isinstance(val, (tuple, list, frozenset, set)):
+            named = tuple(v for v in val if isinstance(v, (str, int)))
+            if named or not val:
+                # an EMPTY axes tuple is a no-op psum (identity) the
+                # partial evaluator sometimes leaves behind — attribute it
+                # to no axis. A NON-empty tuple of unparseable axis objects
+                # must NOT vanish: fall through to "<unknown>" so the
+                # --mesh silence gate fails loudly instead of vacuously.
+                return named
+        elif isinstance(val, (str, int)):
+            return (val,)
+        break
+    return ("<unknown>",)
+
+
+def collectives_by_axis(closed_jaxpr) -> dict:
+    """``{axis_name: Counter(primitive -> count)}`` over the whole program.
+
+    The 2-D mesh invariant this feeds (``tools/halo_audit.py --mesh``): the
+    ``"batch"`` axis must carry ZERO collectives — batched structures are
+    block-diagonal, so all communication (halo ``ppermute``, readout
+    ``psum``) belongs to the ``"spatial"`` axis. A collective naming both
+    axes counts against both (it would already be a violation).
+    """
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    by_axis: dict[str, Counter] = {}
+    for eqn in _iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMS:
+            continue
+        for ax in _eqn_axis_names(eqn):
+            by_axis.setdefault(str(ax), Counter())[name] += 1
+    return by_axis
+
+
+def axis_collective_count(closed_jaxpr, axis_name: str) -> int:
+    """Total collectives attributed to one mesh axis (0 = the axis is
+    communication-free, the batch-axis acceptance gate)."""
+    counts = collectives_by_axis(closed_jaxpr).get(str(axis_name))
+    return int(sum(counts.values())) if counts else 0
+
+
 def ppermutes_by_scope(closed_jaxpr) -> Counter:
     """Counter of name-stack string -> ppermute count (best effort: name
     stacks are source metadata and may be absent on some jax builds, in

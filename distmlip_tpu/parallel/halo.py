@@ -360,6 +360,29 @@ class LocalGraph:
             return edge_feats.at[idx].set(vals, mode="drop")
 
     # ---- reductions ----
+    def structure_sum(self, per_atom):
+        """Per-structure sums of a per-atom quantity on a packed graph.
+
+        Axis-scoped batched readout: one masked ``segment_sum`` onto the
+        shard's ``batch_size`` structure slots (owned rows only — halo and
+        padded rows carry the ``batch_size`` sentinel and drop), then a
+        ``psum`` over the SPATIAL axis so every slab of a spatially
+        partitioned structure contributes. The batch axis is never
+        touched: batch rows hold disjoint structures, so their readout is
+        pure concatenation (shard_map out_specs), not communication.
+        Returns ``(batch_size,)`` in ``per_atom``'s dtype.
+        """
+        if self.struct_id is None or self.batch_size <= 0:
+            raise ValueError(
+                "structure_sum requires a packed graph (struct_id + "
+                "batch_size); build it with pack_structures()")
+        with scope("structure_sum"):
+            e = jnp.where(self.owned_mask, per_atom.reshape(-1), 0)
+            out = jax.ops.segment_sum(
+                e, self.struct_id, num_segments=self.batch_size,
+                indices_are_sorted=True)
+            return self.psum(out)
+
     def owned_sum(self, per_atom):
         """Sum a per-atom quantity over owned nodes, reduced across the mesh."""
         with scope("owned_sum"):

@@ -33,7 +33,8 @@ from ..geometry import apply_strain
 from ..partition.graph import PartitionedGraph
 from ..telemetry import scope
 from .halo import local_graph_from_stacked
-from .mesh import GRAPH_AXIS
+from .mesh import (BATCH_AXIS, GRAPH_AXIS, SPATIAL_AXIS, mesh_row_axes,
+                   mesh_shape)
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     shard_map = jax.shard_map
@@ -49,15 +50,34 @@ _CHECK_KW = ("check_vma" if "check_vma"
 _NO_CHECK = {_CHECK_KW: False}
 
 
-def graph_in_specs(graph: PartitionedGraph) -> PartitionedGraph:
+def graph_row_axes(graph: PartitionedGraph):
+    """Mesh axes the graph's leading partition axis shards over.
+
+    A 2-D-placed graph (``batch_parts > 1``) factors its leading axis as
+    (batch, spatial) row-major and shards over BOTH named axes jointly;
+    every other graph (single structure, or a packed batch confined to one
+    batch row) shards over the spatial axis only and REPLICATES over any
+    batch axis the mesh has — which is what lets an oversized request run
+    on the spatial sub-axis of the same serving mesh.
+    """
+    return (BATCH_AXIS, SPATIAL_AXIS) if graph.batch_parts > 1 \
+        else SPATIAL_AXIS
+
+
+def graph_in_specs(graph: PartitionedGraph, axes=None) -> PartitionedGraph:
     """A pytree of PartitionSpecs matching ``graph``'s treedef.
 
-    Per-partition arrays shard their leading P axis over the graph axis;
-    halo tables (S, P, H) shard axis 1; lattice and scalars replicate.
+    Per-partition arrays shard their leading P axis over ``axes`` (default
+    ``graph_row_axes(graph)`` — the spatial axis, or (batch, spatial)
+    jointly for 2-D-placed packed graphs; the runtime passes
+    ``mesh_row_axes(mesh)`` so rows never replicate over a present batch
+    axis); halo tables (S, P, H) shard axis 1; lattice and scalars
+    replicate.
     """
     import dataclasses
 
-    row, table, rep = P(GRAPH_AXIS), P(None, GRAPH_AXIS), P()
+    axes = graph_row_axes(graph) if axes is None else axes
+    row, table, rep = P(axes), P(None, axes), P()
     return dataclasses.replace(
         graph,
         positions=row, species=row, node_mask=row, owned_mask=row,
@@ -70,6 +90,24 @@ def graph_in_specs(graph: PartitionedGraph) -> PartitionedGraph:
         bond_halo_send_idx=table, bond_halo_send_mask=table,
         bond_halo_recv_idx=table,
         struct_id=None if graph.struct_id is None else row,
+    )
+
+
+def graph_shardings(mesh: Mesh, graph: PartitionedGraph):
+    """NamedSharding pytree placing ``graph`` on ``mesh``.
+
+    One definition of placement identity for every lane (DistPotential,
+    BatchedPotential): per-partition rows shard over ``mesh_row_axes(mesh)``
+    (so rows never replicate over a present batch axis), halo tables shard
+    axis 1, scalars replicate — exactly the in_specs the runtime's
+    shard_map programs consume.
+    """
+    from jax.sharding import NamedSharding
+
+    specs = graph_in_specs(graph, mesh_row_axes(mesh))
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
     )
 
 
@@ -117,11 +155,12 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None,
         return total_energy
 
     def total_energy(params, graph, positions, strain):
-        out_specs = (P(), P(GRAPH_AXIS)) if aux else P()
+        axes = mesh_row_axes(mesh)
+        out_specs = (P(), P(axes)) if aux else P()
         sharded = shard_map(
             local_energy,
             mesh=mesh,
-            in_specs=(P(), P(), graph_in_specs(graph), P(GRAPH_AXIS)),
+            in_specs=(P(), P(), graph_in_specs(graph, axes), P(axes)),
             out_specs=out_specs,
             **_NO_CHECK,
         )
@@ -169,11 +208,12 @@ def make_site_fn(model_site_fn, mesh: Mesh | None,
 
     @jax.jit
     def site_fn(params, graph, positions):
+        axes = mesh_row_axes(mesh)
         sharded = shard_map(
             local_site,
             mesh=mesh,
-            in_specs=(P(), graph_in_specs(graph), P(GRAPH_AXIS)),
-            out_specs=P(GRAPH_AXIS),
+            in_specs=(P(), graph_in_specs(graph, axes), P(axes)),
+            out_specs=P(axes),
             **_NO_CHECK,
         )
         return sharded(params, graph, positions)
@@ -224,76 +264,168 @@ def make_potential_fn(model_energy_fn, mesh: Mesh | None,
     return potential
 
 
-def make_batched_potential_fn(model_energy_fn, compute_stress: bool = True,
-                              aux: bool = False):
-    """Jitted batched potential over a block-diagonally packed graph.
+def _local_batched_energy(model_energy_fn, aux, halo_mode="coalesced"):
+    """Shard-local batched energy: strain -> halo exchange -> model ->
+    per-structure readout. Shared by the single-device packed path and the
+    2-D mesh path (where it runs inside shard_map with the spatial axis
+    bound)."""
 
-    ``(params, graph, positions) -> dict`` where ``graph`` is a
-    single-partition ``PartitionedGraph`` built by
-    :func:`distmlip_tpu.partition.pack_structures` (``batch_size`` B slots,
-    ``struct_id`` per node, Cartesian edge offsets, identity lattice):
-
-    - ``energies``: (B,) per-structure energies — ONE
-      ``segment_sum(e_atoms, struct_id)`` readout over the model's per-atom
-      energies (padded rows carry ``struct_id == B`` and are dropped);
-      empty slots read 0.
-    - ``forces``: (P=1, N_cap, 3) packed per-atom forces from ONE
-      ``value_and_grad`` through the whole super-graph. The blocks share no
-      edges, so d(sum_b E_b)/dx_i = dE_{struct(i)}/dx_i exactly — batching
-      introduces no cross-terms.
-    - ``strain_grad``: (B, 3, 3) dE_b/d(strain_b) — each structure gets its
-      OWN symmetric strain applied to its positions and (Cartesian) edge
-      offsets; divide by per-structure volume on the host for stress.
-    - ``aux`` (``aux=True``): the model's fused per-atom outputs (packed
-      layout, slice per structure on the host).
-
-    The batched path is deliberately single-partition (``mesh=None``): its
-    regime is MANY SMALL structures per device step (the TorchSim batching
-    regime, arXiv:2508.06628), which composes with — rather than replaces —
-    the halo-partitioned path for one large structure. No collectives are
-    traced, so collective counts are independent of B (tools/halo_audit.py
-    ``--batch`` asserts this).
-    """
-
-    def batched_energy(params, strain, graph, positions):
-        lg, _ = local_graph_from_stacked(graph, None, "coalesced")
-        B = graph.batch_size
+    def local_energy(params, strain, graph_local, positions):
+        # graph_local: per-shard (1, ...) slices (or the whole P=1 graph on
+        # the meshless path); strain: (B_local, 3, 3) — this batch shard's
+        # slots only
+        axis = SPATIAL_AXIS if graph_local.spatial_size > 1 else None
+        lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode)
+        B = graph_local.batch_size
         dtype = positions.dtype
         pos = positions[0]
         sid = lg.struct_id
         with scope("apply_strain"):
             # per-structure symmetric strain: x_i -> x_i @ (I + eps_{s(i)});
             # Cartesian edge offsets deform with their structure's cell.
-            # Padded node rows have sid == B — the gather clamps them onto
-            # the last real slot, which is harmless (their rows are masked).
+            # Padded and halo rows have sid == B — the gather clamps them
+            # onto the last real slot, which is harmless (padded rows are
+            # masked; halo rows are overwritten by the exchange below with
+            # their owner's strained coordinates).
             sym = 0.5 * (strain + jnp.swapaxes(strain, -1, -2)).astype(dtype)
             defm = jnp.eye(3, dtype=dtype)[None, :, :] + sym      # (B, 3, 3)
             pos = jnp.einsum("ni,nij->nj", pos, defm[sid])
             esid = sid[lg.edge_dst]  # edge's structure (dst rows are real)
             lg.edge_offset = jnp.einsum(
                 "ei,eij->ej", lg.edge_offset.astype(dtype), defm[esid])
+        # spatially partitioned structures refresh their halo rows from the
+        # owning slab (strained above); a no-op on S=1 placements
+        pos = lg.halo_exchange(pos)
         with scope("model_energy"):
             out = model_energy_fn(params, lg, pos)
         e_atoms, aux_out = out if aux else (out, None)
         with scope("batched_readout"):
-            e = jnp.where(lg.owned_mask,
-                          e_atoms.reshape(-1).astype(dtype), 0)
-            # padded rows carry sid == B (out of range -> dropped); real
-            # rows are contiguous per structure, so indices are sorted
-            energies = jax.ops.segment_sum(
-                e, sid, num_segments=B, indices_are_sorted=True)
-        return jnp.sum(energies), (energies, aux_out)
+            # segment_sum onto batch slots + psum over the SPATIAL axis
+            # only — the batch axis never carries a collective
+            energies = lg.structure_sum(e_atoms.reshape(-1).astype(dtype))
+        return energies, aux_out
+
+    return local_energy
+
+
+def make_batched_potential_fn(model_energy_fn, compute_stress: bool = True,
+                              aux: bool = False, mesh: Mesh | None = None):
+    """Jitted batched potential over a block-diagonally packed graph.
+
+    ``(params, graph, positions) -> dict`` where ``graph`` is a
+    ``PartitionedGraph`` built by
+    :func:`distmlip_tpu.partition.pack_structures` (``batch_size`` slots
+    per batch shard, ``struct_id`` per node, Cartesian edge offsets,
+    identity lattice):
+
+    - ``energies``: (B_total,) per-structure energies, where ``B_total =
+      batch_parts * batch_size`` (flat slot order: shard-major) — ONE
+      ``segment_sum(e_atoms, struct_id)`` readout per shard, ``psum``'d
+      over the spatial axis (padded rows carry the sentinel slot and are
+      dropped); empty slots read 0.
+    - ``forces``: (P, N_cap, 3) packed per-atom forces from ONE
+      ``value_and_grad`` through the whole super-graph. The blocks share no
+      edges, so d(sum_b E_b)/dx_i = dE_{struct(i)}/dx_i exactly — batching
+      introduces no cross-terms.
+    - ``strain_grad``: (B_total, 3, 3) dE_b/d(strain_b) — each structure
+      gets its OWN symmetric strain applied to its positions and
+      (Cartesian) edge offsets; divide by per-structure volume on the host
+      for stress.
+    - ``aux`` (``aux=True``): the model's fused per-atom outputs (packed
+      (P, N_cap, ...) layout, slice per structure on the host).
+
+    ``mesh=None`` (default) is the historical single-device path: it
+    requires ``P == 1`` and traces NO collectives, so collective counts are
+    independent of B (``tools/halo_audit.py --batch`` asserts this).
+
+    With a 2-D ``mesh`` (:func:`distmlip_tpu.parallel.device_mesh`) the
+    packed graph may itself be (batch x spatial)-sharded: rows shard over
+    ``("batch", "spatial")`` jointly, each packed structure's slabs ride
+    the halo ``ppermute`` over the SPATIAL axis only, and per-structure
+    energies psum over spatial — the batch axis carries ZERO collectives
+    by construction (``tools/halo_audit.py --mesh B,S`` asserts this).
+    One executable family covers pure batch-parallel (B x 1), the 1-D ring
+    (1 x S) and the mixed B x S placement.
+    """
+    local_energy = _local_batched_energy(model_energy_fn, aux)
+
+    if mesh is None:
+        def batched_energy(params, strain, graph, positions):
+            energies, aux_out = local_energy(params, strain, graph,
+                                             positions)
+            return jnp.sum(energies), (energies, aux_out)
+
+        def check(graph):
+            if graph.num_partitions != 1 or graph.batch_size < 1:
+                raise ValueError(
+                    "make_batched_potential_fn(mesh=None) requires a "
+                    f"single-partition packed graph (got "
+                    f"P={graph.num_partitions}, "
+                    f"batch_size={graph.batch_size}); build it with "
+                    "pack_structures(), or pass the 2-D mesh the graph "
+                    "was packed for.")
+    else:
+        # the batched shard_map addresses BOTH named axes (strain/energies
+        # shard over "batch"); a user-built mesh missing either name would
+        # only fail deep inside jax's axis resolution at first trace
+        missing = [ax for ax in (BATCH_AXIS, SPATIAL_AXIS)
+                   if ax not in mesh.axis_names]
+        if missing:
+            raise ValueError(
+                f"make_batched_potential_fn needs a mesh with named axes "
+                f"({BATCH_AXIS!r}, {SPATIAL_AXIS!r}); this mesh "
+                f"{tuple(mesh.axis_names)} lacks {missing} — build it "
+                f"with parallel.device_mesh(batch, spatial).")
+        mesh_bp, mesh_sp = mesh_shape(mesh)
+
+        def batched_energy(params, strain, graph, positions):
+            axes = mesh_row_axes(mesh)
+            row = P(axes)
+            # strain shards over batch only: every spatial slab of a batch
+            # row sees its row's (B_local, 3, 3) slice
+            in_specs = (P(), P(BATCH_AXIS), graph_in_specs(graph, axes), row)
+            if aux:
+                def local_aux(params, strain, graph_local, positions):
+                    energies, aux_out = local_energy(
+                        params, strain, graph_local, positions)
+                    # restore the leading shard axis so aux rows concat
+                    # back to the packed (P, N_cap, ...) layout
+                    return energies, jax.tree.map(lambda a: a[None], aux_out)
+
+                sharded = shard_map(
+                    local_aux, mesh=mesh, in_specs=in_specs,
+                    out_specs=(P(BATCH_AXIS), row), **_NO_CHECK)
+                energies, aux_out = sharded(params, strain, graph, positions)
+            else:
+                def local_e(params, strain, graph_local, positions):
+                    return local_energy(params, strain, graph_local,
+                                        positions)[0]
+
+                sharded = shard_map(
+                    local_e, mesh=mesh, in_specs=in_specs,
+                    out_specs=P(BATCH_AXIS), **_NO_CHECK)
+                energies = sharded(params, strain, graph, positions)
+                aux_out = None
+            return jnp.sum(energies), (energies, aux_out)
+
+        def check(graph):
+            if graph.batch_size < 1 or graph.struct_id is None:
+                raise ValueError(
+                    "make_batched_potential_fn requires a packed graph "
+                    "(batch_size >= 1); build it with pack_structures().")
+            if (graph.batch_parts != mesh_bp
+                    or graph.spatial_size != mesh_sp):
+                raise ValueError(
+                    f"graph placement {graph.batch_parts}x"
+                    f"{graph.spatial_size} does not match the "
+                    f"{mesh_bp}x{mesh_sp} mesh; pack with "
+                    f"batch_parts={mesh_bp}, spatial_parts={mesh_sp}.")
 
     @jax.jit
     def potential(params, graph, positions):
-        if graph.num_partitions != 1 or graph.batch_size < 1:
-            raise ValueError(
-                "make_batched_potential_fn requires a single-partition "
-                f"packed graph (got P={graph.num_partitions}, "
-                f"batch_size={graph.batch_size}); build it with "
-                "pack_structures().")
-        B = graph.batch_size
-        strain = jnp.zeros((B, 3, 3), dtype=positions.dtype)
+        check(graph)
+        B_total = graph.batch_parts * graph.batch_size
+        strain = jnp.zeros((B_total, 3, 3), dtype=positions.dtype)
         grad_fn = jax.value_and_grad(
             batched_energy, argnums=(3, 1) if compute_stress else 3,
             has_aux=True)
@@ -304,7 +436,7 @@ def make_batched_potential_fn(model_energy_fn, compute_stress: bool = True,
             g_pos, g_strain = grads
         else:
             g_pos = grads
-            g_strain = jnp.zeros((B, 3, 3), dtype=positions.dtype)
+            g_strain = jnp.zeros((B_total, 3, 3), dtype=positions.dtype)
         out = {"energies": energies, "forces": -g_pos,
                "strain_grad": g_strain}
         if aux:

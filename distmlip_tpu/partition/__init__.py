@@ -1,11 +1,12 @@
 from .plan import PartitionPlan
 from .partitioner import build_block_plan, build_plan, PartitionError
 from .graph import (PartitionedGraph, HostGraphData, build_partitioned_graph,
-                    device_refresh_graph, refresh_edges)
-from .capacity import (BucketPolicy, CapacityPolicy, geometric_bucket,
-                       round_capacity)
-from .batch import (PackedHostData, bucket_key, build_packed_refresh_spec,
-                    device_refresh_packed, pack_structures, packed_stats)
+                    device_refresh_graph, expand_shift_tables, refresh_edges)
+from .capacity import (BucketPolicy, CapacityPolicy, FixedCaps,
+                       geometric_bucket, round_capacity)
+from .batch import (MeshPackedHostData, PackedHostData, bucket_key,
+                    build_packed_refresh_spec, device_refresh_packed,
+                    pack_structures, pack_structures_mesh, packed_stats)
 
 __all__ = [
     "PartitionPlan",
@@ -19,10 +20,14 @@ __all__ = [
     "device_refresh_graph",
     "CapacityPolicy",
     "BucketPolicy",
+    "FixedCaps",
     "geometric_bucket",
     "round_capacity",
+    "expand_shift_tables",
     "PackedHostData",
+    "MeshPackedHostData",
     "pack_structures",
+    "pack_structures_mesh",
     "packed_stats",
     "bucket_key",
     "build_packed_refresh_spec",

@@ -58,6 +58,37 @@ def geometric_bucket(n: int, base: int = 128, growth: float = 2.0 ** 0.5,
     return ((int(math.ceil(rung)) + multiple - 1) // multiple) * multiple
 
 
+class FixedCaps:
+    """Capacity policy that returns PRECOMPUTED values, ignoring ``needed``.
+
+    The mesh packer builds every batch shard's graph with identical static
+    shapes: it first computes the worst-case need per capacity name across
+    ALL shards, quantizes once through the real policy, and then hands each
+    shard build a ``FixedCaps`` so no shard can land on a different rung.
+    Unknown names fall back to the wrapped policy (defensive — all names
+    are precomputed in practice).
+    """
+
+    def __init__(self, caps: dict[str, int], fallback=None):
+        self._caps = dict(caps)
+        self._fallback = fallback
+
+    def get(self, name: str, needed: int) -> int:
+        cap = self._caps.get(name)
+        if cap is None:
+            if self._fallback is None:
+                raise KeyError(
+                    f"FixedCaps has no precomputed capacity {name!r} "
+                    f"(have {sorted(self._caps)}) and no fallback policy")
+            cap = self._fallback.get(name, needed)
+            self._caps[name] = cap  # stay consistent across shards
+        if needed > cap:
+            raise ValueError(
+                f"FixedCaps[{name!r}] = {cap} cannot hold {needed} — the "
+                f"precomputed cross-shard maximum was wrong")
+        return cap
+
+
 class CapacityPolicy:
     """Sticky capacities: grow in buckets, never shrink (per process).
 

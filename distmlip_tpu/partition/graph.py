@@ -53,7 +53,7 @@ _default_caps = CapacityPolicy()
         "struct_id",
     ],
     meta_fields=["num_partitions", "shifts", "has_bond_graph", "n_cap",
-                 "e_cap", "b_cap", "e_split", "batch_size"],
+                 "e_cap", "b_cap", "e_split", "batch_size", "spatial_parts"],
 )
 @dataclass
 class PartitionedGraph:
@@ -111,6 +111,27 @@ class PartitionedGraph:
     # segment_sum readout drops them.
     batch_size: int = 0
     struct_id: Any = None   # (P, N_cap) int32 when batch_size > 0
+    # --- 2-D mesh placement (parallel/mesh.py) ---
+    # spatial_parts: size of the spatial (halo-ring) sub-axis of the
+    # leading partition axis. 0 = legacy 1-D placement (the whole leading
+    # axis is spatial). When set, the leading axis factors as
+    # (batch_parts, spatial_parts) in row-major order — partition
+    # p = b * spatial_parts + s — and shards over the 2-D mesh's
+    # ("batch", "spatial") axes jointly. batch_size then counts structure
+    # slots PER BATCH SHARD (total slots = batch_parts * batch_size).
+    spatial_parts: int = 0
+
+    @property
+    def spatial_size(self) -> int:
+        """Spatial (ring) extent of the leading partition axis."""
+        return self.spatial_parts if self.spatial_parts > 0 \
+            else self.num_partitions
+
+    @property
+    def batch_parts(self) -> int:
+        """Batch-axis extent of the leading partition axis (1 = no batch
+        sharding)."""
+        return self.num_partitions // self.spatial_size
 
 
 @dataclass
@@ -192,6 +213,25 @@ def _halo_tables(plan: PartitionPlan, section_fn, n_cap, caps, name,
             if len(fr_idx):
                 recv_idx[si, p, : len(fr_idx)] = fr_idx
     return shifts, send_idx, send_mask, recv_idx
+
+
+def expand_shift_tables(tbl, used_shifts, all_shifts, fill):
+    """Re-index per-shift halo tables (S, P, H) onto a union shift tuple.
+
+    Rows for shifts the table didn't use are filled with ``fill`` (0 /
+    False / the drop slot), so every partition's program sees the same
+    static shift set. Shared by ``build_partitioned_graph`` and the mesh
+    packer (``partition.batch``), which must equalize shift tuples across
+    independently built batch shards.
+    """
+    if tuple(used_shifts) == tuple(all_shifts) or not all_shifts:
+        return tbl
+    _, P_, H = tbl.shape
+    out = np.full((max(len(all_shifts), 1), P_, H), fill, dtype=tbl.dtype)
+    for i, s in enumerate(all_shifts):
+        if s in used_shifts:
+            out[i] = tbl[list(used_shifts).index(s)]
+    return out
 
 
 def build_partitioned_graph(
@@ -346,24 +386,13 @@ def build_partitioned_graph(
         b_recv = np.zeros((1, P, 0), dtype=np.int32)
         all_shifts = shifts
 
-    def _expand(tbl, used_shifts, fill):
-        """Re-index per-shift tables onto the union shift tuple."""
-        if tuple(used_shifts) == tuple(all_shifts) or not all_shifts:
-            return tbl
-        S, P_, H = tbl.shape
-        out = np.full((max(len(all_shifts), 1), P_, H), fill, dtype=tbl.dtype)
-        for i, s in enumerate(all_shifts):
-            if s in used_shifts:
-                out[i] = tbl[list(used_shifts).index(s)]
-        return out
-
-    h_send = _expand(h_send, shifts, 0)
-    h_smask = _expand(h_smask, shifts, False)
-    h_recv = _expand(h_recv, shifts, n_cap)
+    h_send = expand_shift_tables(h_send, shifts, all_shifts, 0)
+    h_smask = expand_shift_tables(h_smask, shifts, all_shifts, False)
+    h_recv = expand_shift_tables(h_recv, shifts, all_shifts, n_cap)
     if plan.has_bond_graph:
-        b_send = _expand(b_send, b_shifts, 0)
-        b_smask = _expand(b_smask, b_shifts, False)
-        b_recv = _expand(b_recv, b_shifts, b_cap)
+        b_send = expand_shift_tables(b_send, b_shifts, all_shifts, 0)
+        b_smask = expand_shift_tables(b_smask, b_shifts, all_shifts, False)
+        b_recv = expand_shift_tables(b_recv, b_shifts, all_shifts, b_cap)
 
     graph = PartitionedGraph(
         num_partitions=P,
@@ -522,6 +551,11 @@ def graph_build_stats(graph: PartitionedGraph) -> dict:
             (frontier / np.maximum(edges, 1)).max()) if len(edges) else 0.0,
         "halo_send_per_part": [int(x) for x in send],
         "halo_recv_per_part": [int(x) for x in recv],
+        # 2-D mesh placement of the leading partition axis (legacy 1-D
+        # graphs report (1, P) — batch axis unused)
+        "spatial_parts": graph.spatial_size,
+        "batch_parts": graph.batch_parts,
+        "mesh_shape": [graph.batch_parts, graph.spatial_size],
     }
     if graph.has_bond_graph:
         bsend = np.asarray(graph.bond_halo_send_mask).sum(axis=(0, 2))

@@ -135,8 +135,14 @@ class ServeEngine:
         sharing the potential outside the engine stays safe).
     fallback : optional DistPotential for structures larger than
         ``max_batch_atoms`` — the single-structure (possibly
-        halo-partitioned) lane. Without one, oversized requests fail their
-        Future with ValueError.
+        halo-partitioned) lane. When the shared ``BatchedPotential`` runs
+        on a 2-D mesh and no explicit fallback is given, the engine builds
+        the lane AUTOMATICALLY on the SPATIAL sub-axis of that same mesh
+        (a ``DistPotential`` over one batch row's spatial devices): small
+        requests pack onto the batch axis, oversized ones spatially
+        partition across the spatial axis — one mesh, two routes, uniform
+        ``last_stats`` telemetry either way. Without a mesh or explicit
+        fallback, oversized requests fail their Future with ValueError.
     max_batch : micro-batch slot budget (power of two keeps the packed
         ``batch_size`` bucket stable).
     max_wait_s : max time a request waits for co-batching before the
@@ -176,6 +182,8 @@ class ServeEngine:
             raise ValueError("max_batch and max_queue must be >= 1")
         self.potential = potential
         self.fallback = fallback
+        self._spatial_lane = None         # lazily built mesh spatial lane
+        self._spatial_lane_error = None
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
         self.max_queue = int(max_queue)
@@ -277,6 +285,12 @@ class ServeEngine:
         thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout)
+        # the auto-built spatial lane is engine-owned (unlike an explicit
+        # user fallback): release its background-rebuild worker and cached
+        # graphs deterministically rather than waiting on GC
+        lane, self._spatial_lane = self._spatial_lane, None
+        if lane is not None:
+            lane.close()
 
     def __enter__(self):
         return self
@@ -439,6 +453,47 @@ class ServeEngine:
         self.stats.failed += 1
         req.future.set_exception(exc)
 
+    def _oversized_lane(self):
+        """The potential serving oversized structures: the explicit
+        ``fallback`` if configured, else a lazily built ``DistPotential``
+        over the SPATIAL sub-axis of the shared BatchedPotential's mesh
+        (one batch row's spatial devices — same chips, spatial route).
+        Returns None when neither is available."""
+        if self.fallback is not None:
+            return self.fallback
+        mesh = getattr(self.potential, "mesh", None)
+        if mesh is None:
+            return None
+        if self._spatial_lane is None:
+            try:
+                from ..calculators.calculator import DistPotential
+                from ..parallel import mesh_shape
+
+                pot = self.potential
+                _bp, sp = mesh_shape(mesh)
+                # the lane mirrors the shared potential's configuration
+                # (magmoms, skin cache, threading, telemetry) so the two
+                # routes differ only in placement
+                self._spatial_lane = DistPotential(
+                    pot.model, pot.params,
+                    num_partitions=sp,
+                    devices=list(np.asarray(mesh.devices).reshape(-1)[:sp]),
+                    species_map=getattr(pot, "species_map", None),
+                    compute_stress=getattr(pot, "compute_stress", True),
+                    compute_magmom=getattr(pot, "compute_magmom", False),
+                    skin=getattr(pot, "skin", 0.0),
+                    num_threads=getattr(pot, "num_threads", None),
+                    telemetry=getattr(pot, "telemetry", None))
+                self._spatial_lane_error = None
+            except Exception as e:  # noqa: BLE001 - retried next request
+                # remember the cause for the failure message but do NOT
+                # latch it: a transient build failure (OOM while a batch is
+                # resident, backend hiccup) must not disable the lane for
+                # the engine's lifetime
+                self._spatial_lane_error = e
+                return None
+        return self._spatial_lane
+
     def _run_fallback(self, req: _Request, t_dispatch: float) -> None:
         live = self._start_requests([req])
         if not live:
@@ -446,22 +501,36 @@ class ServeEngine:
         req = live[0]
         t0 = time.perf_counter()
         try:
-            if self.fallback is None:
+            lane = self._oversized_lane()
+            if lane is None:
                 raise ValueError(
                     f"structure with {req.n_atoms} atoms exceeds "
                     f"max_batch_atoms={self.max_batch_atoms} and no "
-                    f"fallback DistPotential is configured")
+                    f"fallback DistPotential (or batched-potential mesh "
+                    f"spatial axis) is configured"
+                ) from self._spatial_lane_error
             if not _finite_positions(req.atoms):
                 raise ValueError("non-finite positions")
-            result = self.fallback.calculate(req.atoms)
+            # snapshot last_stats in the same critical section as the
+            # call (same rule as _run_batch): a direct caller sharing an
+            # explicit fallback potential must not overwrite the stats
+            # between this request executing and the engine reading them
+            lock = getattr(lane, "_lock", None)
+            with lock if lock is not None else _NULL_CTX:
+                result = lane.calculate(req.atoms)
+                pot_stats = dict(getattr(lane, "last_stats", None) or {})
         except Exception as e:  # noqa: BLE001 - isolate to this request
             self._fail(req, e)
             return
         t_done = self._clock()
         self.stats.fallback_requests += 1
         self._resolve(req, result, t_done)
+        # unified stats emission: the spatial/fallback lane reports the
+        # same last_stats surface the batched lane does, so fallback
+        # batches no longer bypass graph/occupancy telemetry
         self._emit_record("serve_fallback", [req], t_dispatch, t_done,
-                          service_s=time.perf_counter() - t0)
+                          service_s=time.perf_counter() - t0,
+                          pot_stats=pot_stats)
 
     def _run_batch(self, batch: list[_Request], t_dispatch: float) -> None:
         batch = self._start_requests(batch)
@@ -554,7 +623,10 @@ class ServeEngine:
         )
         for k in ("bucket_key", "node_occupancy", "edge_occupancy",
                   "padding_waste_frac", "n_atoms", "rebuild_count",
-                  "rebuild_on_device", "rebuild_overflow_count"):
+                  "rebuild_on_device", "rebuild_overflow_count",
+                  "num_partitions", "n_cap", "e_cap",
+                  "mesh_shape", "spatial_parts", "batch_parts",
+                  "halo_send_per_part"):
             if pot_stats and k in pot_stats:
                 setattr(rec, k, pot_stats[k])
         tel.emit(rec)

@@ -58,6 +58,11 @@ class StepRecord:
     # partition) — the non-overlappable tail of the interior/frontier split
     frontier_edge_frac: float = 0.0
 
+    # --- 2-D mesh placement (parallel/mesh.py; 0/empty = unknown/legacy) ---
+    mesh_shape: list[int] = field(default_factory=list)  # [batch, spatial]
+    spatial_parts: int = 0           # spatial (halo-ring) extent of the placement
+    batch_parts: int = 0             # batch-shard extent of the placement
+
     # --- batched multi-structure engine (calculators/batched.py) ---
     batch_size: int = 0              # real structures this step (0: unbatched)
     bucket_key: str = ""             # compiled-shape bucket id (n/e/B caps)
@@ -141,6 +146,24 @@ class StepRecord:
             return 1.0
         mean = sum(v) / len(v)
         return (max(v) / mean) if mean > 0 else 1.0
+
+    def spatial_halo_imbalance(self) -> float:
+        """Halo-send imbalance measured PER MESH AXIS: on a 2-D placement
+        each batch row is its own spatial ring, so max/mean is computed
+        within each row (different batch shards legitimately carry
+        different structures/volumes) and the worst row is reported.
+        Falls back to the flat ``halo_imbalance`` off-mesh."""
+        v = self.halo_send_per_part
+        S = self.spatial_parts
+        if not v or S <= 1 or len(v) % S != 0:
+            return self.halo_imbalance()
+        worst = 1.0
+        for b in range(len(v) // S):
+            row = v[b * S:(b + 1) * S]
+            mean = sum(row) / S
+            if mean > 0:
+                worst = max(worst, max(row) / mean)
+        return worst
 
 
 # ---------------------------------------------------------------------------

@@ -76,6 +76,14 @@ class Report:
                 f"mean={c['mean_node_occupancy']:.2f}; "
                 f"edge min={c['min_edge_occupancy']:.2f} "
                 f"mean={c['mean_edge_occupancy']:.2f}")
+        if "mesh_placements" in c:
+            placements = " ".join(
+                f"{b}x{s}" for b, s in c["mesh_placements"])
+            line = (f"mesh placement (batch x spatial): {placements}")
+            if "max_spatial_halo_imbalance" in c:
+                line += (f"; spatial-ring send imbalance worst="
+                         f"{c['max_spatial_halo_imbalance']:.2f}")
+            out.append(line)
         if "max_halo_imbalance" in c:
             out.append(f"halo send imbalance (max/mean over partitions): "
                        f"worst={c['max_halo_imbalance']:.2f}")
@@ -186,9 +194,25 @@ def aggregate(
         c["mean_node_occupancy"] = sum(node_occ) / len(node_occ)
         c["min_edge_occupancy"] = min(edge_occ)
         c["mean_edge_occupancy"] = sum(edge_occ) / len(edge_occ)
-    imb = [r.halo_imbalance() for r in records if r.halo_send_per_part]
+    # per-axis measure everywhere: on a 2-D placement each batch row is its
+    # own spatial ring, so the summary metric must not conflate rows (same
+    # rule the anomaly check below applies); off-mesh it equals the flat
+    # max/mean
+    imb = [r.spatial_halo_imbalance() for r in records
+           if r.halo_send_per_part]
     if imb:
         c["max_halo_imbalance"] = max(imb)
+    # 2-D mesh placements: which (batch x spatial) shapes the run used and
+    # the worst per-axis (per batch row) spatial halo imbalance
+    placements = sorted({tuple(r.mesh_shape) for r in records
+                         if len(r.mesh_shape) == 2
+                         and (r.mesh_shape[0] > 1 or r.mesh_shape[1] > 1)})
+    if placements:
+        c["mesh_placements"] = [list(p) for p in placements]
+        sp_imb = [r.spatial_halo_imbalance() for r in records
+                  if r.halo_send_per_part and r.spatial_parts > 1]
+        if sp_imb:
+            c["max_spatial_halo_imbalance"] = max(sp_imb)
     # overlap pipeline + cost model (0-valued fields = producer didn't know)
     modes = sorted({r.halo_mode for r in records if r.halo_mode})
     if modes:
@@ -328,7 +352,22 @@ def aggregate(
             f"overflow fallback(s)) — grow capacities or check structure "
             f"churn; the hot loop is stalling on host FPIS rebuilds"))
     for r in records:
-        if r.halo_send_per_part and r.halo_imbalance() > imbalance_factor:
+        if not r.halo_send_per_part:
+            continue
+        if r.spatial_parts > 1 and r.batch_parts > 1:
+            # 2-D placement: measure imbalance per mesh axis — each batch
+            # row is an independent spatial ring, so a flat max/mean
+            # across all partitions would conflate legitimately different
+            # batch shards with a genuinely skewed ring
+            imb_r = r.spatial_halo_imbalance()
+            if imb_r > imbalance_factor:
+                rep.anomalies.append(Anomaly(
+                    "spatial_halo_imbalance", r.step,
+                    f"per-batch-row spatial halo send max/mean = "
+                    f"{imb_r:.2f} (> {imbalance_factor:.1f}) on a "
+                    f"{r.batch_parts}x{r.spatial_parts} placement — "
+                    f"volumes {r.halo_send_per_part}"))
+        elif r.halo_imbalance() > imbalance_factor:
             rep.anomalies.append(Anomaly(
                 "halo_imbalance", r.step,
                 f"per-partition halo send max/mean = "

@@ -1,0 +1,396 @@
+"""2-D mesh GSPMD placements: batch-parallel x graph-parallel on one mesh.
+
+The exactness contract under test: for EVERY placement of a packed batch on
+the named ``Mesh(("batch", "spatial"))`` — pure batch-parallel (B, 1), the
+1-D spatial ring (1, S), and the mixed (B, S) case where each packed
+structure is itself spatially partitioned with halo exchange on the spatial
+axis — per-structure energies/forces/stresses (/magmoms) match the
+single-device reference to fp32 roundoff, for all four model families.
+
+The communication contract: the batch axis carries ZERO collectives at any
+placement, and the spatial-axis ppermute count of the packed (B, S) program
+equals the 1-D graph-parallel ring's at P=S (packing adds structures, not
+communication). Asserted at the jaxpr level via the per-axis collective
+attribution (parallel/audit.py) and the ``tools/halo_audit.py --mesh``
+gate.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import Atoms, BatchedPotential, DistPotential
+from distmlip_tpu.models import PairConfig, PairPotential
+from distmlip_tpu.parallel import (BATCH_AXIS, SPATIAL_AXIS, device_mesh,
+                                   graph_mesh, make_batched_potential_fn,
+                                   mesh_shape)
+from distmlip_tpu.parallel.audit import (axis_collective_count,
+                                         collectives_by_axis)
+from distmlip_tpu.partition import BucketPolicy, bucket_key, pack_structures
+
+pytestmark = pytest.mark.mesh2d
+
+# (batch_parts, spatial_parts) placements exercised on the 8-CPU-device
+# conftest mesh; (4, 2) uses all 8 devices
+PLACEMENTS = [(4, 1), (1, 2), (4, 2)]
+
+
+def make_structure(rng, reps=(4, 1, 1), a=3.5, noise=0.05, n_species=2,
+                   species_lo=0):
+    """Perturbed fcc supercell wide enough along x to slab into S=2 parts
+    at cutoff 3.2 (slab rule: extent / S > 2 * cutoff)."""
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, noise, (len(frac), 3))
+    z = rng.integers(species_lo, species_lo + n_species,
+                     len(frac)).astype(np.int32)
+    return Atoms(numbers=z, positions=cart, cell=lattice)
+
+
+def mesh_batch(rng):
+    """4 structures with different sizes, cells and species populations —
+    every one spatially partitionable into 2 slabs."""
+    return [
+        make_structure(rng, reps=(4, 1, 1)),
+        make_structure(rng, reps=(4, 2, 1), a=3.7, species_lo=1),
+        make_structure(rng, reps=(5, 1, 1), a=3.4),
+        make_structure(rng, reps=(4, 1, 1), a=3.6, n_species=3),
+    ]
+
+
+def assert_placements_match_single(model, params, structs, rng,
+                                   placements=PLACEMENTS,
+                                   compute_magmom=False, atol_f=5e-5,
+                                   rtol_e=5e-6):
+    sp = DistPotential(model, params, num_partitions=1,
+                       compute_magmom=compute_magmom)
+    refs = [sp.calculate(a) for a in structs]
+    for bp_parts, sp_parts in placements:
+        mesh = device_mesh(bp_parts, sp_parts)
+        pot = BatchedPotential(model, params, mesh=mesh,
+                               compute_magmom=compute_magmom)
+        res = pot.calculate(structs)
+        assert len(res) == len(structs)
+        for b, ref in enumerate(refs):
+            scale = max(1.0, abs(ref["energy"]))
+            assert abs(res[b]["energy"] - ref["energy"]) < rtol_e * scale, (
+                f"placement {bp_parts}x{sp_parts} structure {b}: "
+                f"E {res[b]['energy']} vs {ref['energy']}")
+            np.testing.assert_allclose(
+                res[b]["forces"], ref["forces"], atol=atol_f,
+                err_msg=f"placement {bp_parts}x{sp_parts} structure {b}")
+            np.testing.assert_allclose(
+                res[b]["stress"], ref["stress"], atol=atol_f,
+                err_msg=f"placement {bp_parts}x{sp_parts} structure {b}")
+            if compute_magmom:
+                np.testing.assert_allclose(
+                    res[b]["magmoms"], ref["magmoms"], atol=atol_f,
+                    err_msg=f"placement {bp_parts}x{sp_parts} structure {b}")
+
+
+def _pair_model():
+    model = PairPotential(PairConfig(cutoff=3.2, kind="lj"))
+    return model, model.init()
+
+
+# ---------------------------------------------------------------------------
+# packing invariants at the (B, S) placement
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_mesh_pack_invariants(rng):
+    structs = mesh_batch(rng)
+    graph, host = pack_structures(structs, cutoff=3.2,
+                                  spatial_parts=2, batch_parts=4)
+    assert graph.num_partitions == 8
+    assert graph.spatial_parts == 2 and graph.spatial_size == 2
+    assert graph.batch_parts == 4
+    assert graph.batch_size == 1           # 1 structure slot per shard
+    assert host.per_shard == 1
+    assert "_m4x2" in bucket_key(graph)
+    # per partition: owned-row struct_id nondecreasing, halo/pad rows carry
+    # the sentinel, and every real edge stays inside one structure block
+    sid = np.asarray(graph.struct_id)
+    owned = np.asarray(graph.owned_mask)
+    nmask = np.asarray(graph.node_mask)
+    for p in range(graph.num_partitions):
+        s_own = sid[p][owned[p]]
+        assert np.all(np.diff(s_own) >= 0)
+        assert np.all(sid[p][~nmask[p]] == graph.batch_size)
+        halo = nmask[p] & ~owned[p]
+        assert np.all(sid[p][halo] == graph.batch_size)
+        # packed edge_dst stays sorted per partition (unsplit layout)
+        assert np.all(np.diff(np.asarray(graph.edge_dst[p])) >= 0)
+    # round trip: positions scatter/gather is the identity on owned rows
+    pos = host.scatter_positions([a.positions for a in structs],
+                                 dtype=np.float64)
+    back = host.gather_per_structure(pos)
+    for b, atoms in enumerate(structs):
+        np.testing.assert_allclose(back[b], atoms.positions)
+    # flat slot mapping covers each structure exactly once
+    slots = host.structure_slots
+    assert len(set(slots.tolist())) == len(structs)
+    stats = host.stats
+    assert stats["mesh_shape"] == [4, 2]
+    assert stats["spatial_parts"] == 2 and stats["batch_parts"] == 4
+    assert stats["batch_slots"] == 4
+
+
+@pytest.mark.tier1
+def test_mesh_pack_empty_shards(rng):
+    """B < batch_parts leaves trailing shards empty — the placement still
+    packs, runs and reads zeros for the empty slots."""
+    structs = mesh_batch(rng)[:2]
+    graph, host = pack_structures(structs, cutoff=3.2,
+                                  spatial_parts=2, batch_parts=4)
+    assert graph.num_partitions == 8
+    model, params = _pair_model()
+    mesh = device_mesh(4, 2)
+    pot = make_batched_potential_fn(model.energy_fn, mesh=mesh)
+    out = pot(params, jax.device_put(graph), graph.positions)
+    energies = np.asarray(out["energies"])
+    # slots of the two real structures are finite; all others exactly 0
+    real = set(host.structure_slots.tolist())
+    for slot in range(graph.batch_parts * graph.batch_size):
+        if slot not in real:
+            assert energies[slot] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# parity across placements, all four model families
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_mesh_parity_pair(rng):
+    model, params = _pair_model()
+    assert_placements_match_single(model, params, mesh_batch(rng), rng)
+
+
+@pytest.mark.tier1
+def test_mesh_parity_chgnet_with_magmoms(rng):
+    from distmlip_tpu.models.chgnet import CHGNet, CHGNetConfig
+
+    cfg = CHGNetConfig(num_species=4, units=16, num_rbf=6, num_angle=4,
+                       num_blocks=2, cutoff=3.2, bond_cutoff=2.6)
+    model = CHGNet(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    assert_placements_match_single(model, params, mesh_batch(rng), rng,
+                                   compute_magmom=True)
+
+
+@pytest.mark.tier1
+def test_mesh_parity_tensornet(rng):
+    from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+
+    model = TensorNet(TensorNetConfig(num_species=4, units=16, num_rbf=8,
+                                      num_layers=2, cutoff=3.2))
+    params = model.init(jax.random.PRNGKey(0))
+    assert_placements_match_single(model, params, mesh_batch(rng), rng)
+
+
+def test_mesh_parity_mace(rng):
+    from distmlip_tpu.models import MACE, MACEConfig
+
+    model = MACE(MACEConfig(
+        num_species=4, channels=16, l_max=2, a_lmax=2, hidden_lmax=1,
+        correlation=3, num_interactions=2, num_bessel=6, radial_mlp=16,
+        cutoff=3.2, avg_num_neighbors=12.0))
+    params = model.init(jax.random.PRNGKey(0))
+    assert_placements_match_single(model, params, mesh_batch(rng), rng)
+
+
+def test_mesh_parity_escn(rng):
+    """eSCN's MOLE gate is the one non-block-diagonal piece: at (B, S) the
+    per-structure composition pool must psum over the spatial ring."""
+    from distmlip_tpu.models import ESCN, ESCNConfig
+
+    model = ESCN(ESCNConfig(num_species=4, channels=16, l_max=2,
+                            num_layers=2, num_bessel=6, num_experts=4,
+                            cutoff=3.2, avg_num_neighbors=12.0))
+    params = model.init(jax.random.PRNGKey(0))
+    assert_placements_match_single(model, params, mesh_batch(rng), rng)
+
+
+# ---------------------------------------------------------------------------
+# communication contract: batch axis silent, spatial matches the 1-D ring
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_zero_batch_axis_collectives(rng):
+    model, params = _pair_model()
+    structs = mesh_batch(rng)
+    spatial_pp = {}
+    for bp_parts, sp_parts in PLACEMENTS:
+        mesh = device_mesh(bp_parts, sp_parts)
+        graph, _ = pack_structures(structs, cutoff=3.2,
+                                   spatial_parts=sp_parts,
+                                   batch_parts=bp_parts)
+        pot = make_batched_potential_fn(model.energy_fn, mesh=mesh)
+        jaxpr = jax.make_jaxpr(pot)(params, graph, graph.positions)
+        assert axis_collective_count(jaxpr, BATCH_AXIS) == 0, (
+            f"{bp_parts}x{sp_parts}: batch axis must be silent, got "
+            f"{collectives_by_axis(jaxpr)}")
+        by_axis = collectives_by_axis(jaxpr)
+        spatial_pp[(bp_parts, sp_parts)] = by_axis.get(
+            SPATIAL_AXIS, {}).get("ppermute", 0)
+    # no halo traffic at S=1; identical ring traffic at S=2 whatever B is
+    assert spatial_pp[(4, 1)] == 0
+    assert spatial_pp[(4, 2)] == spatial_pp[(1, 2)] > 0
+
+
+@pytest.mark.tier1
+def test_halo_audit_mesh_flag():
+    import tools.halo_audit as ha
+
+    rc = ha.main(["--model", "pair", "--mesh", "2,2", "--json"])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# BatchedPotential on a mesh: skin cache, bucket telemetry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_mesh_batched_potential_skin_reuse(rng):
+    model, params = _pair_model()
+    pot = BatchedPotential(model, params, skin=0.6, mesh=device_mesh(2, 2))
+    structs = mesh_batch(rng)
+    res0 = pot.calculate(structs)
+    assert pot.rebuild_count == 1
+    for a in structs:
+        a.positions += rng.normal(0, 0.01, a.positions.shape)
+    pot.calculate(structs)
+    assert pot.rebuild_count == 1  # reused: positions-only upload
+    structs[0].positions += 0.5
+    pot.calculate(structs)
+    assert pot.rebuild_count == 2
+    assert pot.last_stats["mesh_shape"] == [2, 2]
+    assert pot.last_stats["batch_slots"] == 4
+    assert "_m2x2" in pot.last_bucket_key
+    # skin-cache hit results stay exact (envelope zeroes skin edges)
+    sp = DistPotential(model, params, num_partitions=1)
+    for b, atoms in enumerate(structs):
+        ref = sp.calculate(atoms)
+        res = pot.calculate(structs)[b]
+        assert abs(res["energy"] - ref["energy"]) < 5e-6 * max(
+            1.0, abs(ref["energy"]))
+    assert res0 is not None
+
+
+# ---------------------------------------------------------------------------
+# serving: oversized requests route to the spatial axis of the same mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_serve_engine_routes_oversized_to_spatial_axis(rng):
+    from distmlip_tpu.serve import ServeEngine
+    from distmlip_tpu.telemetry import Telemetry
+    from distmlip_tpu.telemetry.sinks import AggregatingSink
+
+    class CaptureSink:
+        def __init__(self):
+            self.records = []
+
+        def emit(self, rec):
+            self.records.append(rec)
+
+        def close(self):
+            pass
+
+    model, params = _pair_model()
+    small = [make_structure(rng, reps=(2, 1, 1)) for _ in range(3)]
+    big = make_structure(rng, reps=(5, 2, 2))
+    cap = CaptureSink()
+    tel = Telemetry([AggregatingSink(), cap])
+    engine = ServeEngine(
+        BatchedPotential(model, params, mesh=device_mesh(4, 2)),
+        max_batch=4, max_wait_s=0.005,
+        max_batch_atoms=len(big) - 1, telemetry=tel)
+    futures = [engine.submit(a) for a in small + [big]]
+    assert engine.drain(timeout=120)
+    results = [f.result(timeout=60) for f in futures]
+    # the spatial lane was built from the shared mesh (no explicit fallback)
+    assert engine.fallback is None
+    lane = engine._spatial_lane
+    assert lane is not None and lane.num_partitions == 2
+    assert mesh_shape(lane.mesh) == (1, 2)
+    engine.close()
+    # close() releases the engine-owned lane deterministically
+    assert engine._spatial_lane is None
+    assert engine.stats.fallback_requests == 1
+    # parity on both routes
+    sp = DistPotential(model, params, num_partitions=1)
+    for atoms, res in zip(small + [big], results):
+        ref = sp.calculate(atoms)
+        assert abs(res["energy"] - ref["energy"]) < 5e-5 * max(
+            1.0, abs(ref["energy"]))
+        np.testing.assert_allclose(res["forces"], ref["forces"], atol=5e-5)
+    # unified stats emission: the fallback record carries graph stats now
+    fb = [r for r in cap.records if r.kind == "serve_fallback"]
+    assert len(fb) == 1
+    assert fb[0].n_atoms == len(big)
+    assert fb[0].num_partitions == 2      # spatial lane at S=2
+    batch_recs = [r for r in cap.records if r.kind == "serve_batch"]
+    assert batch_recs and batch_recs[0].mesh_shape == [4, 2]
+
+
+# ---------------------------------------------------------------------------
+# telemetry: mesh fields in records + report rendering
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_mesh_telemetry_fields_and_report(rng, tmp_path):
+    from distmlip_tpu.telemetry import JsonlSink, Telemetry
+    from distmlip_tpu.telemetry.report import aggregate, read_jsonl
+
+    path = str(tmp_path / "mesh.jsonl")
+    tel = Telemetry([JsonlSink(path)])
+    model, params = _pair_model()
+    pot = BatchedPotential(model, params, mesh=device_mesh(2, 2),
+                           telemetry=tel)
+    structs = mesh_batch(rng)
+    pot.calculate(structs)
+    tel.close()
+    records = read_jsonl(path)
+    assert len(records) == 1
+    rec = records[0]
+    assert rec.mesh_shape == [2, 2]
+    assert rec.spatial_parts == 2 and rec.batch_parts == 2
+    assert rec.halo_send_per_part and len(rec.halo_send_per_part) == 4
+    rep = aggregate(records)
+    assert rep.counters["mesh_placements"] == [[2, 2]]
+    assert "mesh placement (batch x spatial): 2x2" in rep.render()
+
+
+def test_spatial_halo_imbalance_flagged_per_axis():
+    """A skewed spatial ring flags; legitimately different batch rows with
+    balanced rings do NOT (the per-axis attribution satellite)."""
+    from distmlip_tpu.telemetry import StepRecord
+    from distmlip_tpu.telemetry.report import aggregate
+
+    balanced_rows = StepRecord(
+        step=1, kind="batched_calculate", spatial_parts=2, batch_parts=2,
+        mesh_shape=[2, 2],
+        # batch rows differ 10x, but each spatial ring is balanced
+        halo_send_per_part=[100, 100, 10, 10])
+    assert balanced_rows.spatial_halo_imbalance() == pytest.approx(1.0)
+    skewed_ring = StepRecord(
+        step=2, kind="batched_calculate", spatial_parts=2, batch_parts=2,
+        mesh_shape=[2, 2],
+        halo_send_per_part=[100, 10, 50, 50])
+    assert skewed_ring.spatial_halo_imbalance() > 1.5
+    rep = aggregate([balanced_rows, skewed_ring], imbalance_factor=1.5)
+    kinds = [a.kind for a in rep.anomalies]
+    assert kinds.count("spatial_halo_imbalance") == 1
+    rep_ok = aggregate([balanced_rows], imbalance_factor=1.5)
+    assert not [a for a in rep_ok.anomalies
+                if "imbalance" in a.kind]

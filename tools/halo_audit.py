@@ -2,7 +2,8 @@
 """Audit collective counts of the graph-parallel potential programs.
 
     python tools/halo_audit.py [--model chgnet|pair|tensornet]
-        [--nparts 2] [--reps 4,2,2] [--batch B] [--per-scope] [--json]
+        [--nparts 2] [--reps 4,2,2] [--batch B] [--mesh B,S]
+        [--per-scope] [--json]
 
 Builds a small test system, traces the jitted potential under BOTH halo
 modes (plus the fused-aux and legacy site-readout programs when the model
@@ -17,7 +18,15 @@ batched potential at batch sizes 1 and B: collective counts MUST be
 independent of B (the batched engine is single-partition by design — a
 batch adds zero communication). A violation exits 3.
 
-Exit codes: 0 ok, 2 usage, 3 batched collective counts depend on B.
+``--mesh B,S`` traces the 2-D mesh batched potential at the (batch=B,
+spatial=S) placement and attributes every collective to its mesh axis:
+the BATCH axis must carry ZERO collectives (block-diagonal batches need
+no cross-batch traffic), and at S > 1 the spatial-axis ppermute count
+must MATCH the 1-D graph-parallel ring at P=S (packing adds structures,
+not communication). A violation exits 3.
+
+Exit codes: 0 ok, 2 usage, 3 invariant violated (batched counts depend
+on B, batch-axis collectives, or spatial ppermute mismatch).
 """
 
 import argparse
@@ -89,6 +98,11 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=0,
                     help="also audit the batched (packed) potential at "
                          "batch sizes 1 and B; counts must not depend on B")
+    ap.add_argument("--mesh", default=None,
+                    help="B,S: audit the 2-D mesh batched potential at the "
+                         "(batch=B, spatial=S) placement — the batch axis "
+                         "must carry zero collectives and the spatial "
+                         "ppermute count must match the 1-D ring at P=S")
     ap.add_argument("--per-scope", action="store_true")
     ap.add_argument("--json", action="store_true")
     try:
@@ -99,6 +113,11 @@ def main(argv=None) -> int:
             reps = tuple(int(x) for x in args.reps.split(","))
         if len(reps) != 3:
             raise ValueError("--reps wants gx,gy,gz")
+        mesh_bs = None
+        if args.mesh:
+            mesh_bs = tuple(int(x) for x in args.mesh.split(","))
+            if len(mesh_bs) != 2 or mesh_bs[0] < 1 or mesh_bs[1] < 1:
+                raise ValueError("--mesh wants B,S (both >= 1)")
     except (SystemExit, ValueError) as e:
         if isinstance(e, SystemExit) and e.code in (0, None):
             return 0
@@ -174,15 +193,98 @@ def main(argv=None) -> int:
         batch_ok = len(set(totals.values())) == 1
         report["batched_collectives_independent_of_B"] = batch_ok
 
+    mesh_ok = True
+    mesh_detail = ""
+    if mesh_bs is not None:
+        B_m, S_m = mesh_bs
+        from distmlip_tpu.calculators import Atoms
+        from distmlip_tpu.parallel import (BATCH_AXIS, SPATIAL_AXIS,
+                                           device_mesh, graph_mesh,
+                                           make_batched_potential_fn,
+                                           make_potential_fn)
+        from distmlip_tpu.parallel.audit import collectives_by_axis
+        from distmlip_tpu.partition import build_partitioned_graph as _bpg
+        from distmlip_tpu.partition import build_plan as _bp
+        from distmlip_tpu.partition import pack_structures
+
+        import numpy as np
+        rng = np.random.default_rng(2)
+        # the mesh system needs slabs wide enough for S_m spatial parts
+        cart_m, lat_m, species_m = build_system(
+            (max(2 * S_m, 4), 2, 2), args.model)
+        base = Atoms(numbers=species_m + 1, positions=cart_m, cell=lat_m)
+
+        def jittered_m():
+            a = base.copy()
+            a.positions = a.positions + rng.normal(0, 0.02, a.positions.shape)
+            return a
+
+        try:
+            mesh = device_mesh(B_m, S_m)
+        except ValueError as e:
+            # a placement that doesn't fit the host's devices is a usage
+            # error (exit 2), not an invariant violation (exit 3)
+            print(f"usage: {e}", file=sys.stderr)
+            return 2
+        bgraph, _ = pack_structures(
+            [jittered_m() for _ in range(B_m)], model.cfg.cutoff, bond_r,
+            use_bg, species_fn=lambda z: (z - 1).astype("int32"),
+            spatial_parts=S_m, batch_parts=B_m)
+        bfn_mesh = make_batched_potential_fn(model.energy_fn, mesh=mesh)
+        jaxpr_m = jax.make_jaxpr(bfn_mesh)(params, bgraph, bgraph.positions)
+        by_axis = {ax: dict(cnt)
+                   for ax, cnt in collectives_by_axis(jaxpr_m).items()}
+        batch_coll = sum(by_axis.get(BATCH_AXIS, {}).values())
+        mesh_pp = by_axis.get(SPATIAL_AXIS, {}).get("ppermute", 0)
+        # collectives whose axis metadata could not be parsed (a jax
+        # version changing the eqn param names) would make the gate pass
+        # VACUOUSLY — count them as a violation, not a pass
+        unattributed = sum(by_axis.get("<unknown>", {}).values())
+        entry = {"total": sum(sum(c.values()) for c in by_axis.values()),
+                 "by_axis": by_axis, "batch_axis_collectives": batch_coll,
+                 "spatial_ppermutes": mesh_pp,
+                 "unattributed_collectives": unattributed}
+        # 1-D ring reference at P=S on ONE copy of the same system: the
+        # packed placement must pay exactly the ring's ppermutes, no more
+        if S_m > 1:
+            nl_m = neighbor_list_numpy(cart_m, lat_m, [1, 1, 1], r,
+                                       bond_r=bond_r)
+            plan_m = _bp(nl_m, lat_m, [1, 1, 1], S_m, r, bond_r, use_bg)
+            graph_m, _h = _bpg(plan_m, nl_m, species_m, lat_m)
+            ring_fn = make_potential_fn(model.energy_fn, graph_mesh(S_m))
+            jaxpr_r = jax.make_jaxpr(ring_fn)(params, graph_m,
+                                              graph_m.positions)
+            ring_axes = collectives_by_axis(jaxpr_r)
+            ring_pp = ring_axes.get(SPATIAL_AXIS, {}).get("ppermute", 0)
+            entry["ring_ppermutes_1d"] = ring_pp
+            mesh_ok = (batch_coll == 0 and unattributed == 0
+                       and mesh_pp == ring_pp)
+            mesh_detail = (f"batch_collectives={batch_coll} "
+                           f"spatial_ppermutes={mesh_pp} (1-D ring: "
+                           f"{ring_pp})")
+        else:
+            mesh_ok = batch_coll == 0 and unattributed == 0
+            mesh_detail = f"batch_collectives={batch_coll}"
+        if unattributed:
+            mesh_detail += f" UNATTRIBUTED={unattributed}"
+        report["programs"][f"mesh[{B_m}x{S_m}]"] = entry
+        report["mesh_batch_axis_silent"] = batch_coll == 0
+        report["mesh_ok"] = mesh_ok
+
+    ok = batch_ok and mesh_ok
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
-        return 0 if batch_ok else 3
+        return 0 if ok else 3
     print(f"halo audit: model={args.model} P={args.nparts} "
           f"atoms={report['n_atoms']} e_split={graph.e_split}/{graph.e_cap}")
     for name, entry in report["programs"].items():
         parts = " ".join(f"{k}={v}" for k, v in entry.items()
-                         if k not in ("total", "ppermutes_by_scope"))
+                         if k not in ("total", "ppermutes_by_scope",
+                                      "by_axis"))
         print(f"  {name:<28} total={entry['total']:<4} {parts}")
+        for ax, cnt in entry.get("by_axis", {}).items():
+            print(f"      axis {ax}: "
+                  + " ".join(f"{k}={v}" for k, v in cnt.items()))
         for scope, n in entry.get("ppermutes_by_scope", {}).items():
             print(f"      {n:3d}x {scope}")
     pot_c = report["programs"].get("potential[coalesced]", {}).get("total", 0)
@@ -192,7 +294,12 @@ def main(argv=None) -> int:
     if args.batch > 0:
         verdict = "independent of B" if batch_ok else "DEPEND ON B (bug!)"
         print(f"  batched collective counts: {verdict}")
-    return 0 if batch_ok else 3
+    if mesh_bs is not None:
+        verdict = ("batch axis silent, spatial matches the ring"
+                   if mesh_ok else "VIOLATED (bug!)")
+        print(f"  mesh placement {mesh_bs[0]}x{mesh_bs[1]}: {verdict} "
+              f"[{mesh_detail}]")
+    return 0 if (batch_ok and mesh_ok) else 3
 
 
 if __name__ == "__main__":

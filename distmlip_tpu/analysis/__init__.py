@@ -1,0 +1,40 @@
+"""Static program-contract analysis for the distmlip_tpu runtime.
+
+The performance story rests on invariants that are *stated* everywhere and
+were only spot-checked: the dst-sorted padding contract
+(``indices_are_sorted=True`` on every hot-path segment sum), zero
+batch-axis collectives on the 2-D mesh, the "N MD steps = ONE device
+program" guarantee, f32 on the device path, the logarithmic compile
+bound. This package proves them statically — on CPU, in CI, with no chip:
+
+- :mod:`~distmlip_tpu.analysis.ir` — one jaxpr walker (recursing into
+  pjit/scan/while/cond/remat/shard_map sub-jaxprs, tracking named_scope
+  stacks and control-flow paths) that ``parallel/audit.py`` is now a thin
+  compatibility shim over;
+- :mod:`~distmlip_tpu.analysis.passes` — the registered
+  :class:`ContractPass`es (collective_placement, host_sync,
+  dtype_discipline, scatter_hints, recompile_hazard, dead_compute), each
+  returning typed :class:`Finding`s with severity and scope location;
+- :mod:`~distmlip_tpu.analysis.lint` — AST rules jaxprs can't see
+  (host pulls in device-path code, wallclock in jit, unused imports);
+- ``tools/contract_check.py`` — the CLI that traces the real programs
+  across placements and gates CI (exit 3 on any unsuppressed ERROR).
+
+Audited exceptions: ``# contract: allow(<pass>)`` on the flagged source
+line (see :mod:`~distmlip_tpu.analysis.findings`).
+"""
+
+from .findings import (Finding, Severity, apply_suppressions,
+                       clear_suppression_cache, error_count, exit_code,
+                       format_findings, warning_count)
+from .passes import (REGISTRY, ContractPass, Program, get_passes, register,
+                     run_passes)
+from . import ir
+from .lint import lint_file, lint_paths
+
+__all__ = [
+    "Finding", "Severity", "error_count", "warning_count", "exit_code",
+    "format_findings", "apply_suppressions", "clear_suppression_cache",
+    "ContractPass", "Program", "REGISTRY", "register", "get_passes",
+    "run_passes", "ir", "lint_file", "lint_paths",
+]

@@ -1,0 +1,151 @@
+"""Typed findings emitted by contract passes and the AST lint.
+
+A :class:`Finding` pins a contract violation to a *place*: the program it
+was traced from, the ``jax.named_scope`` stack inside the jaxpr, the stack
+of enclosing control-flow primitives (``pjit`` / ``while`` / ``scan`` /
+``cond``), and — best effort — the source file:line the offending eqn was
+traced from. Severity drives exit codes: ``tools/contract_check.py`` exits
+3 on any unsuppressed ERROR.
+
+Suppression contract (audited exceptions): a source line carrying
+
+    # contract: allow(<pass-or-rule>[, <pass-or-rule>...])
+
+(on the flagged line or the line directly above it) downgrades findings of
+that pass/rule at that location to ``suppressed=True`` — they still print,
+but no longer fail the check. This is deliberately file:line-scoped so an
+exception audited for one call site never blankets the repo.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, replace
+
+_ALLOW_RE = re.compile(r"#\s*contract:\s*allow\(([^)]*)\)")
+
+
+class Severity(enum.IntEnum):
+    """Finding severity; only ERROR fails a contract check."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # table rendering
+        return self.name.lower()
+
+
+@dataclass
+class Finding:
+    """One contract violation (or informational note) with its location."""
+
+    pass_name: str                      # registered pass / lint rule family
+    severity: Severity
+    message: str
+    program: str = ""                   # traced-program name ("" for lint)
+    scope: str = ""                     # jax.named_scope stack at the eqn
+    path: tuple = ()                    # enclosing control-flow primitives
+    location: tuple | None = None       # (file, line) best effort
+    rule: str = ""                      # sub-rule id (lint: DML001, F401...)
+    suppressed: bool = False
+
+    def where(self) -> str:
+        parts = []
+        if self.location:
+            parts.append(f"{self.location[0]}:{self.location[1]}")
+        if self.path:
+            parts.append("/".join(self.path))
+        if self.scope:
+            parts.append(self.scope)
+        return " ".join(parts) or "<program>"
+
+    def render(self) -> str:
+        sup = " [suppressed]" if self.suppressed else ""
+        rule = f"/{self.rule}" if self.rule else ""
+        return (f"{str(self.severity).upper():<7} {self.pass_name}{rule}"
+                f"{sup}: {self.message}  @ {self.where()}")
+
+
+def error_count(findings) -> int:
+    return sum(1 for f in findings
+               if f.severity == Severity.ERROR and not f.suppressed)
+
+
+def exit_code(findings) -> int:
+    """The contract-check CLI exit-code convention: 3 on any unsuppressed
+    ERROR finding, else 0 (shared by tools/contract_check.py and the
+    seeded-violation tests)."""
+    return 3 if error_count(findings) else 0
+
+
+def warning_count(findings) -> int:
+    return sum(1 for f in findings
+               if f.severity == Severity.WARNING and not f.suppressed)
+
+
+def format_findings(findings, header: str | None = None) -> str:
+    lines = [] if header is None else [header]
+    for f in sorted(findings, key=lambda f: (-int(f.severity), f.pass_name)):
+        lines.append("  " + f.render())
+    if not findings:
+        lines.append("  (clean)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# suppression: "# contract: allow(pass)" comments at the flagged line
+# ---------------------------------------------------------------------------
+
+_allow_cache: dict[str, dict[int, frozenset]] = {}
+
+
+def _allows_in_file(path: str) -> dict[int, frozenset]:
+    """{line_number: frozenset(allowed pass/rule names)} for one source file.
+
+    Cached per path — the checker reads each flagged file once.
+    """
+    cached = _allow_cache.get(path)
+    if cached is not None:
+        return cached
+    allows: dict[int, frozenset] = {}
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            for lineno, line in enumerate(fh, start=1):
+                m = _ALLOW_RE.search(line)
+                if m:
+                    names = frozenset(
+                        n.strip() for n in m.group(1).split(",") if n.strip())
+                    allows[lineno] = names
+    except OSError:
+        pass
+    _allow_cache[path] = allows
+    return allows
+
+
+def clear_suppression_cache() -> None:
+    _allow_cache.clear()
+
+
+def apply_suppressions(findings) -> list:
+    """Mark findings whose source line (or the line above) carries a
+    matching ``# contract: allow(...)`` comment. Returns a new list;
+    findings without a source location are never suppressible."""
+    out = []
+    for f in findings:
+        if f.location:
+            allows = _allows_in_file(str(f.location[0]))
+            lineno = int(f.location[1])
+            names = allows.get(lineno, frozenset()) | allows.get(
+                lineno - 1, frozenset())
+            if f.pass_name in names or (f.rule and f.rule in names):
+                f = replace(f, suppressed=True)
+        out.append(f)
+    return out
+
+
+__all__ = [
+    "Severity", "Finding", "error_count", "warning_count", "exit_code",
+    "format_findings", "apply_suppressions", "clear_suppression_cache",
+]

@@ -1,0 +1,262 @@
+"""Repo-specific AST lint: rules jaxprs cannot see.
+
+A jaxpr only shows what survived tracing — by the time ``float(jnp_x)``
+has forced a device sync, the jaxpr looks innocent. These rules run on
+the *source*:
+
+- **DML001 host-pull-in-device-code**: ``.item()``, ``float(...)`` /
+  ``int(...)`` / ``bool(...)`` directly wrapping a ``jnp.*``/``jax.*``
+  call, or ``np.asarray(...)`` of a non-numpy expression, inside
+  device-path functions of the hot modules (``models/``, ``ops/``,
+  ``parallel/``). Each forces a blocking device->host transfer per call —
+  inside jitted code, a trace-time concretization error at best and a
+  silent sync at worst.
+- **DML002 wallclock-in-jit**: ``time.time()`` / ``time.perf_counter()``
+  inside a function that gets jitted — the value is baked at trace time,
+  so the "timestamp" is a constant from the first call.
+- **F401 unused-import** (ruff-compatible code): module-level imports
+  never referenced (dunder-all re-exports and ``import x as x``
+  re-export idiom respected). The one pyflakes rule worth enforcing
+  without pyflakes in the image.
+
+Device-path heuristic (documented contract, not magic): a function is
+device-path if it is decorated with ``jax.jit``/``jit``/
+``partial(jax.jit, ...)``, is passed to ``jax.jit(...)`` by name in the
+same module, has a parameter named ``lg`` (the LocalGraph calling
+convention every model energy fn uses), or is nested inside such a
+function.
+
+Suppression: ``# contract: allow(lint)`` or ``# contract: allow(DML001)``
+on the flagged line (or the line above), same syntax as the jaxpr passes.
+Ruff handles the generic pycodestyle/pyflakes/isort surface via
+``[tool.ruff]`` in pyproject.toml; this lint stays repo-specific so both
+run from one ``tools/contract_check.py --lint`` entry point.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Finding, Severity, apply_suppressions
+
+HOT_MODULE_DIRS = ("models", "ops", "parallel")
+
+_TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time"}
+
+
+def _dotted(node) -> str:
+    """Best-effort dotted name of an expression ("jnp.sum", "time.time")."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _DeviceFns(ast.NodeVisitor):
+    """Collect device-path function defs per the documented heuristic."""
+
+    def __init__(self):
+        self.jitted_names: set[str] = set()   # names passed to jax.jit(...)
+        self.device_fns: list = []            # FunctionDef nodes
+
+    def collect(self, tree):
+        # first sweep: names jitted by call — jax.jit(f), shard_map(f, ...)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                callee = _dotted(node.func)
+                if callee.endswith("jit") or callee.endswith("shard_map"):
+                    for arg in node.args[:1]:
+                        if isinstance(arg, ast.Name):
+                            self.jitted_names.add(arg.id)
+        self.visit(tree)
+        return self.device_fns
+
+    def _is_device_fn(self, node) -> bool:
+        for dec in node.decorator_list:
+            d = _dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+            if d.endswith("jit"):
+                return True
+            if isinstance(dec, ast.Call) and _dotted(dec.func) == "partial":
+                for a in dec.args:
+                    if _dotted(a).endswith("jit"):
+                        return True
+        if node.name in self.jitted_names:
+            return True
+        args = node.args
+        names = [a.arg for a in (*args.posonlyargs, *args.args,
+                                 *args.kwonlyargs)]
+        return "lg" in names
+
+    def visit_FunctionDef(self, node):
+        if self._is_device_fn(node):
+            self.device_fns.append(node)
+            # nested defs inherit device-path status; don't double-visit
+            return
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _numpy_rooted(node) -> bool:
+    if isinstance(node, ast.Call):
+        root = _dotted(node.func).split(".")[0]
+        return root in ("np", "numpy")
+    return isinstance(node, ast.Constant)
+
+
+def _lint_device_fn(fn, path: str, in_hot_module: bool) -> list:
+    findings = []
+
+    def emit(node, rule, msg):
+        findings.append(Finding(
+            pass_name="lint", severity=Severity.ERROR, message=msg,
+            location=(path, node.lineno), rule=rule))
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        # DML002 applies to every device fn, hot module or not
+        if (callee.split(".")[0] == "time"
+                and callee.split(".")[-1] in _TIME_FUNCS):
+            emit(node, "DML002",
+                 f"{callee}() inside a jitted function is baked at trace "
+                 "time — hoist it to the host caller")
+            continue
+        if not in_hot_module:
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            emit(node, "DML001",
+                 ".item() in device-path code forces a blocking "
+                 "device->host transfer")
+        elif (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.args and isinstance(node.args[0], ast.Call)
+                and _dotted(node.args[0].func).split(".")[0]
+                in ("jnp", "jax", "lax")):
+            emit(node, "DML001",
+                 f"{node.func.id}(jnp...) concretizes a device value in "
+                 "device-path code")
+        elif (callee in ("np.asarray", "numpy.asarray", "np.array",
+                         "numpy.array")
+                and node.args and not _numpy_rooted(node.args[0])):
+            emit(node, "DML001",
+                 f"{callee}(...) on a (potentially) device value in "
+                 "device-path code pulls it to the host")
+    return findings
+
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+
+
+def _noqa_lines(source: str) -> dict[int, frozenset]:
+    """{lineno: frozenset(codes) | frozenset() for bare noqa} — standard
+    pyflakes/ruff suppression, honored so one file can satisfy both this
+    lint and ruff with a single comment."""
+    out: dict[int, frozenset] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _NOQA_RE.search(line)
+        if m:
+            codes = m.group("codes")
+            out[lineno] = frozenset(
+                c.strip() for c in codes.split(",")) if codes else frozenset()
+    return out
+
+
+def _lint_unused_imports(tree, path: str, noqa: dict) -> list:
+    def suppressed(node) -> bool:
+        codes = noqa.get(node.lineno)
+        return codes is not None and (not codes or "F401" in codes)
+
+    imported: dict[str, tuple] = {}  # bound name -> (node, display)
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            if suppressed(node):
+                continue
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                if alias.asname == alias.name:
+                    continue  # "import x as x" re-export idiom
+                imported[bound] = (node, alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__" or suppressed(node):
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name
+                if alias.asname == alias.name:
+                    continue
+                imported[bound] = (node, alias.name)
+    if not imported:
+        return []
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and not isinstance(node.ctx, ast.Store):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    # names re-exported via __all__ strings count as used
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)):
+            for elt in ast.walk(node.value):
+                if isinstance(elt, ast.Constant) and isinstance(elt.value,
+                                                                str):
+                    used.add(elt.value)
+    findings = []
+    for bound, (node, display) in imported.items():
+        if bound not in used:
+            findings.append(Finding(
+                pass_name="lint", severity=Severity.ERROR,
+                message=f"{display!r} imported but unused",
+                location=(path, node.lineno), rule="F401"))
+    return findings
+
+
+def lint_file(path: str, package_root: str | None = None) -> list:
+    """Lint one Python file; returns (possibly suppressed) findings."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError) as e:
+        return [Finding(pass_name="lint", severity=Severity.ERROR,
+                        message=f"unparseable: {e}", location=(path, 1),
+                        rule="E999")]
+    rel = os.path.relpath(path, package_root) if package_root else path
+    parts = rel.replace(os.sep, "/").split("/")
+    in_hot = any(p in HOT_MODULE_DIRS for p in parts[:-1])
+    findings = []
+    for fn in _DeviceFns().collect(tree):
+        findings.extend(_lint_device_fn(fn, path, in_hot))
+    findings.extend(_lint_unused_imports(tree, path, _noqa_lines(source)))
+    return apply_suppressions(findings)
+
+
+def lint_paths(paths, package_root: str | None = None) -> list:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    findings = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        findings.extend(lint_file(
+                            os.path.join(dirpath, f), package_root))
+        elif p.endswith(".py"):
+            findings.extend(lint_file(p, package_root))
+    return findings

@@ -1,0 +1,129 @@
+"""Contract-pass registry.
+
+A :class:`ContractPass` inspects one traced :class:`Program` and returns
+typed :class:`~distmlip_tpu.analysis.findings.Finding`s. Passes register
+themselves with :func:`register`; :func:`run_passes` runs every applicable
+registered pass over a program and applies ``# contract: allow(...)``
+suppressions. ``tools/contract_check.py`` is the CLI over this registry;
+``tools/halo_audit.py``'s mesh/batch gates and the runtime's telemetry
+contract counts ride the same passes.
+
+Program *tags* scope applicability: a pass with ``requires = {"forward"}``
+only runs on forward-only programs (``scatter_hints`` — the transposed
+gather in a grad program legitimately emits unsorted scatter-adds, so the
+hint contract is stated on the forward hot path). Common tags:
+
+- ``"forward"`` — forward-only energy program (no autodiff transpose)
+- ``"grad"`` — full value_and_grad potential program
+- ``"device_resident"`` — must run with ZERO host syncs (DeviceMD chunk)
+- ``"mesh"`` — traced under a named-mesh shard_map placement
+- ``"x64"`` — traced under enable_x64 (f64 leaks stay visible instead of
+  being silently canonicalized to f32)
+
+Per-program expectations ride ``Program.config`` — see each pass's
+docstring for the keys it reads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ..findings import (Finding, Severity, apply_suppressions, error_count,
+                        format_findings, warning_count)
+
+
+@dataclass
+class Program:
+    """One traced program under contract check."""
+
+    name: str
+    jaxpr: object                       # ClosedJaxpr from jax.make_jaxpr
+    tags: frozenset = frozenset()
+    config: dict = field(default_factory=dict)
+
+    def tagged(self, *names) -> bool:
+        return frozenset(names) <= self.tags
+
+
+class ContractPass:
+    """Base class: subclass, set ``name``/``description``, implement
+    :meth:`run`, and decorate with :func:`register`."""
+
+    name: str = ""
+    description: str = ""
+    requires: frozenset = frozenset()   # run only when tags cover these
+
+    def applicable(self, program: Program) -> bool:
+        return self.requires <= program.tags
+
+    def run(self, program: Program) -> list:
+        raise NotImplementedError
+
+    # helper: findings inherit the program name automatically
+    def finding(self, severity: Severity, message: str, *, site=None,
+                rule: str = "", location=None) -> Finding:
+        from .. import ir
+
+        scope, path = "", ()
+        if site is not None:
+            scope, path = site.scope, site.path
+            if location is None:
+                location = ir.source_location(site.eqn)
+        return Finding(pass_name=self.name, severity=severity,
+                       message=message, scope=scope, path=path,
+                       location=location, rule=rule)
+
+
+REGISTRY: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator adding a ContractPass to the global registry."""
+    if not cls.name:
+        raise ValueError(f"{cls.__name__} needs a non-empty .name")
+    REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_passes(names=None) -> list:
+    """Instantiate registered passes (all, or the named subset in
+    registry order). Unknown names raise KeyError."""
+    if names is None:
+        return [cls() for cls in REGISTRY.values()]
+    missing = [n for n in names if n not in REGISTRY]
+    if missing:
+        raise KeyError(
+            f"unknown contract pass(es) {missing}; registered: "
+            f"{sorted(REGISTRY)}")
+    return [REGISTRY[n]() for n in REGISTRY if n in set(names)]
+
+
+def run_passes(program: Program, passes=None, suppress: bool = True) -> list:
+    """Run every applicable pass over ``program``; findings carry the
+    program name and (with ``suppress=True``) honor source-level
+    ``# contract: allow(...)`` comments."""
+    passes = get_passes() if passes is None else passes
+    findings = []
+    for p in passes:
+        if not p.applicable(program):
+            continue
+        for f in p.run(program):
+            findings.append(replace(f, program=program.name))
+    if suppress:
+        findings = apply_suppressions(findings)
+    return findings
+
+
+__all__ = [
+    "Program", "ContractPass", "REGISTRY", "register", "get_passes",
+    "run_passes", "Finding", "Severity", "error_count", "warning_count",
+    "format_findings",
+]
+
+# importing the submodules registers the built-in passes
+from . import collective_placement  # noqa: E402,F401
+from . import host_sync             # noqa: E402,F401
+from . import dtype_discipline      # noqa: E402,F401
+from . import scatter_hints         # noqa: E402,F401
+from . import recompile_hazard      # noqa: E402,F401
+from . import dead_compute          # noqa: E402,F401

@@ -1,0 +1,121 @@
+"""Collective-placement pass: which mesh axis carries which collectives.
+
+Generalizes the PR 2/PR 6 audit gates into one registered pass:
+
+- per-mesh-axis collective counts are always reported (INFO);
+- ``config["forbidden_axes"]`` (e.g. ``["batch"]``): any collective
+  attributed to a listed axis is an ERROR — the 2-D mesh invariant that
+  block-diagonal batches add ZERO cross-batch communication;
+- ``config["axis_budget"]``: ``{axis: {primitive: count}}`` — audited
+  allowance subtracted before the forbidden-axis gate fires. The one
+  known legitimate case: a grad program's replicated-input cotangent
+  (the strain input is ``P()``-replicated, so its transpose psums over
+  EVERY mesh axis — runtime.py keeps the batch extent 1 on all
+  DistPotential placements, so that psum moves no bytes). Budgeted
+  collectives report as INFO; anything beyond stays an ERROR;
+- ``config["require_attributed"]`` (default True when forbidden axes or
+  expectations are set): collectives whose axis metadata cannot be parsed
+  (a jax version renaming eqn params) are an ERROR — silence gates must
+  never pass vacuously;
+- ``config["expected_ppermutes"]``: ``{axis_name: count}`` ring-parity
+  expectation — the (B, S) placement must pay exactly the 1-D ring's
+  ppermutes at P=S, no more (packing adds structures, not communication);
+- ``config["max_total_collectives"]``: hard ceiling (0 for the
+  single-partition packed program — batching is communication-free);
+- ``config["expected_total_collectives"]``: exact-equality gate — the
+  ``tools/halo_audit.py --batch`` invariant (collective counts must be
+  INDEPENDENT of batch size) pins every B>1 program to the B=1 total.
+"""
+
+from __future__ import annotations
+
+from .. import ir
+from . import ContractPass, Program, Severity, register
+
+
+@register
+class CollectivePlacementPass(ContractPass):
+    name = "collective_placement"
+    description = ("per-mesh-axis collective counts; forbidden-axis "
+                   "silence, ring parity, and total ceilings")
+
+    def run(self, program: Program) -> list:
+        by_axis = ir.collectives_by_axis(program.jaxpr)
+        # total gates count every collective EQN once, exactly like
+        # ir.count_collectives — summing by_axis instead would drop
+        # identity psums (empty axes tuple) and double-count multi-axis
+        # collectives, diverging from the reference totals callers pin
+        # expected_total_collectives to (tools/halo_audit.py --batch)
+        total = sum(ir.count_collectives(program.jaxpr).values())
+        findings = [self.finding(
+            Severity.INFO,
+            "collectives by axis: " + (", ".join(
+                f"{ax}={sum(c.values())}"
+                + " (" + " ".join(f"{k}:{v}" for k, v in sorted(c.items()))
+                + ")"
+                for ax, c in sorted(by_axis.items())) or "none"),
+            rule="counts")]
+
+        cfg = program.config
+        forbidden = tuple(cfg.get("forbidden_axes", ()))
+        expected = dict(cfg.get("expected_ppermutes", ()) or {})
+        budget = {str(ax): dict(prims)
+                  for ax, prims in dict(cfg.get("axis_budget", ())).items()}
+        max_total = cfg.get("max_total_collectives")
+        require_attr = cfg.get(
+            "require_attributed",
+            bool(forbidden or expected or max_total is not None))
+
+        for ax in forbidden:
+            counts = dict(by_axis.get(str(ax), {}))
+            allowed = budget.get(str(ax), {})
+            over = {k: v - min(v, int(allowed.get(k, 0)))
+                    for k, v in counts.items()}
+            within = {k: min(v, int(allowed.get(k, 0)))
+                      for k, v in counts.items() if allowed.get(k)}
+            n_within = sum(within.values())
+            if n_within:
+                findings.append(self.finding(
+                    Severity.INFO,
+                    f"{n_within} budgeted collective(s) on axis {ax!r}: "
+                    + " ".join(f"{k}={v}" for k, v in sorted(within.items()))
+                    + " (audited allowance, axis_budget)",
+                    rule="budgeted-axis"))
+            n = sum(over.values())
+            if n:
+                findings.append(self.finding(
+                    Severity.ERROR,
+                    f"{n} collective(s) on forbidden mesh axis {ax!r}: "
+                    + " ".join(f"{k}={v}" for k, v in
+                               sorted(over.items()) if v),
+                    rule="forbidden-axis"))
+        if require_attr:
+            n = sum(by_axis.get("<unknown>", {}).values())
+            if n:
+                findings.append(self.finding(
+                    Severity.ERROR,
+                    f"{n} collective(s) with unparseable axis metadata — "
+                    "the silence gate would pass vacuously",
+                    rule="unattributed"))
+        for ax, want in expected.items():
+            # alias-robust: a jax build emitting collective_permute instead
+            # of ppermute must not make 0 == 0 pass vacuously
+            got = ir.ppermute_count(by_axis.get(str(ax), {}))
+            if got != int(want):
+                findings.append(self.finding(
+                    Severity.ERROR,
+                    f"axis {ax!r} carries {got} ppermute(s), expected "
+                    f"{int(want)} (1-D ring parity)",
+                    rule="ring-parity"))
+        if max_total is not None and total > int(max_total):
+            findings.append(self.finding(
+                Severity.ERROR,
+                f"{total} collective(s) traced, ceiling is {int(max_total)}",
+                rule="total-ceiling"))
+        expected_total = cfg.get("expected_total_collectives")
+        if expected_total is not None and total != int(expected_total):
+            findings.append(self.finding(
+                Severity.ERROR,
+                f"{total} collective(s) traced, expected exactly "
+                f"{int(expected_total)}", rule="total-parity"))
+        return findings

@@ -1,0 +1,99 @@
+"""Dead-compute pass: eqns with no dataflow path to a program output.
+
+Dead eqns survive tracing (jax's make_jaxpr keeps everything executed;
+DCE happens later, per backend, maybe) and usually mean a refactor left a
+computation behind — at best wasted trace/compile time, at worst a
+forgotten output silently dropped from the return path (CHGNet's dead
+trailing halo exchange, removed in PR 2, was exactly this shape).
+
+Findings are grouped by (primitive, source line): the per-field
+slice/squeeze unpacking in ``local_graph_from_stacked`` legitimately
+leaves a dead eqn per unused graph field, and one finding per *site*
+(with a count) keeps the report readable. Severity splits by cost class:
+
+- WARNING when the dead eqn is (or transitively contains, for pjit/
+  scan/cond call eqns) a collective, callback, scatter or loop — dead
+  communication escapes the other passes' cost models, and a dead
+  scatter often means a forgotten output;
+- INFO otherwise — XLA's DCE reliably erases dead arithmetic and data
+  movement (including the partial-eval leftovers jax's own autodiff
+  leaves in shard_map'd grad programs); code-health noise, not a
+  hazard.
+
+Liveness is computed per (sub)jaxpr: an eqn inside a scan body is judged
+against the body's outputs, not the whole program's. Effectful eqns never
+count as dead. ``config["dead_compute_max_report"]`` (default 10) caps
+the distinct sites reported per program.
+"""
+
+from __future__ import annotations
+
+from .. import ir
+from . import ContractPass, Program, Severity, register
+
+_HAZARD_PRIMS = frozenset(
+    ir.COLLECTIVE_PRIMS | ir.SCATTER_PRIMS | {"while", "scan"})
+
+
+def _is_hazard(eqn) -> bool:
+    """Dead communication / callbacks / scatters / loops warrant a
+    WARNING; anything else XLA's DCE erases for free (INFO)."""
+    name = eqn.primitive.name
+    if name in _HAZARD_PRIMS or ir.is_host_sync(name):
+        return True
+    for sub in ir.sub_jaxprs(eqn.params):
+        for inner in sub.eqns:
+            if _is_hazard(inner):
+                return True
+    return False
+
+
+@register
+class DeadComputePass(ContractPass):
+    name = "dead_compute"
+    description = ("eqns with no path to a program output, grouped per "
+                   "source site (per sub-jaxpr liveness)")
+
+    def run(self, program: Program) -> list:
+        cap = int(program.config.get("dead_compute_max_report", 10))
+        # (primitive, location) -> [count, representative site]
+        sites: dict[tuple, list] = {}
+        seen: set[int] = set()
+        top = getattr(program.jaxpr, "jaxpr", program.jaxpr)
+        groups = [(top, ())] + [
+            (s.jaxpr, s.path) for s in ir.iter_sites(program.jaxpr)]
+        n_dead = 0
+        for jaxpr, path in groups:
+            if id(jaxpr) in seen:
+                continue
+            seen.add(id(jaxpr))
+            for eqn in ir.dead_eqns(jaxpr):
+                n_dead += 1
+                key = (eqn.primitive.name, ir.source_location(eqn))
+                entry = sites.setdefault(key, [0, None])
+                entry[0] += 1
+                if entry[1] is None:
+                    entry[1] = ir.EqnSite(eqn=eqn, path=path,
+                                          scope=ir.scope_of(eqn),
+                                          jaxpr=jaxpr)
+        findings = []
+        # hazards sort ahead of the report cap: a single dead psum must
+        # never be crowded out by high-count dead-arithmetic sites
+        ranked = sorted(
+            ((prim, _is_hazard(site.eqn), count, site)
+             for (prim, _loc), (count, site) in sites.items()),
+            key=lambda t: (not t[1], -t[2]))
+        for prim, hazard, count, site in ranked:
+            if len(findings) >= cap:
+                break
+            sev = Severity.WARNING if hazard else Severity.INFO
+            many = f" x{count}" if count > 1 else ""
+            findings.append(self.finding(
+                sev, f"dead eqn {prim!r}{many} — no path to a program "
+                "output", site=site, rule="dead-eqn"))
+        if len(sites) > cap:
+            findings.append(self.finding(
+                Severity.INFO,
+                f"...and {len(sites) - cap} more dead site(s) "
+                f"({n_dead} dead eqn(s) total)", rule="dead-eqn-more"))
+        return findings

@@ -1,0 +1,131 @@
+"""Dtype-discipline pass: the device path is fp32 (bf16-capable), never f64.
+
+TPUs emulate f64 at ~1/10 throughput; one un-cast ``np.float64`` array
+(geometry helpers are float64 by design on the host) silently promotes
+every downstream op when x64 tracing is on. Checks:
+
+- ERROR: any float64/complex128 aval flowing through an eqn (grouped per
+  primitive so a single leak doesn't emit hundreds of findings). Under
+  the default jax config f64 is canonicalized to f32 at trace time, so
+  programs should be traced under ``jax.experimental.enable_x64`` (tag
+  ``"x64"``) for this check to bite — ``tools/contract_check.py`` does.
+- ERROR: a float64 *host constant* baked into the program
+  (``np.float64`` closure arrays — visible whenever the const value
+  escaped canonicalization).
+- WARNING: scatter-add accumulation at half precision (f16/bf16 segment
+  sums lose ulps per edge; the contract is fp32 accumulation with
+  half-precision storage).
+- WARNING: weak-type drift across ``scan``/``while`` carries (a python
+  scalar promoting the carry dtype re-traces per call site).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from .. import ir
+from . import ContractPass, Program, Severity, register
+
+_BAD = ("float64", "complex128")
+_HALF = ("float16", "bfloat16")
+
+
+def _aval_dtype(v) -> str:
+    try:
+        return str(v.aval.dtype)
+    except Exception:  # noqa: BLE001 - tokens/abstract units have no dtype
+        return ""
+
+
+def _strong_f64(v) -> bool:
+    """True for a non-weak float64/complex128 aval. Weak-typed f64 is a
+    python scalar under x64 tracing — it does NOT promote f32 operands, so
+    only strong f64 (a real np.float64 array on the device path) counts."""
+    try:
+        aval = v.aval
+        return (str(aval.dtype) in _BAD
+                and not bool(getattr(aval, "weak_type", False)))
+    except Exception:  # noqa: BLE001
+        return False
+
+
+@register
+class DtypeDisciplinePass(ContractPass):
+    name = "dtype_discipline"
+    description = ("no f64 avals or f64 host consts on the device path; "
+                   "fp32 scatter accumulation; stable carry weak types")
+
+    def run(self, program: Program) -> list:
+        findings = []
+        f64_sites: dict[str, tuple] = {}   # primitive -> (count, first site)
+        for site in ir.iter_sites(program.jaxpr):
+            eqn = site.eqn
+            if any(_strong_f64(v) for v in (*eqn.invars, *eqn.outvars)):
+                n, first = f64_sites.get(site.primitive, (0, site))
+                f64_sites[site.primitive] = (n + 1, first)
+            if (site.primitive == "scatter-add"
+                    and _aval_dtype(eqn.outvars[0]) in _HALF):
+                findings.append(self.finding(
+                    Severity.WARNING,
+                    f"scatter-add accumulates in "
+                    f"{_aval_dtype(eqn.outvars[0])}; accumulate in fp32 "
+                    "and cast the result", site=site, rule="half-accum"))
+            if site.primitive in ("scan", "while"):
+                findings.extend(self._carry_drift(site))
+        for prim, (n, first) in sorted(f64_sites.items()):
+            findings.append(self.finding(
+                Severity.ERROR,
+                f"float64 aval(s) through {prim!r} x{n} — the device path "
+                "is fp32; cast at the host boundary", site=first,
+                rule="f64-aval"))
+        counts = Counter()
+        for val, aval in ir.program_consts(program.jaxpr):
+            if bool(getattr(aval, "weak_type", False)):
+                continue  # python scalar — does not promote f32 operands
+            # attr reads only: np.asarray(val) on a device-resident const
+            # would block on a device->host transfer. The val dtype is the
+            # one that matters under DEFAULT tracing (jax canonicalizes the
+            # AVAL to f32 but keeps the f64 host array as the const).
+            dt = str(getattr(val, "dtype", ""))
+            if dt in _BAD or str(getattr(aval, "dtype", "")) in _BAD:
+                counts[(dt or str(aval.dtype),
+                        tuple(getattr(aval, "shape", ())))] += 1
+        for (dt, shape), n in sorted(counts.items()):
+            findings.append(self.finding(
+                Severity.ERROR,
+                f"{n} baked-in host const(s) of dtype {dt} shape "
+                f"{list(shape)} — cast before tracing (geometry.py host "
+                "helpers are float64 by design; device consumers must "
+                "downcast)", rule="f64-const"))
+        return findings
+
+    def _carry_drift(self, site) -> list:
+        eqn = site.eqn
+        out = []
+        try:
+            if site.primitive == "scan":
+                num_consts = int(eqn.params.get("num_consts", 0))
+                num_carry = int(eqn.params.get("num_carry", 0))
+                ins = eqn.invars[num_consts:num_consts + num_carry]
+                outs = eqn.outvars[:num_carry]
+            else:  # while: carry = invars minus cond/body consts
+                cn = int(eqn.params.get("cond_nconsts", 0))
+                bn = int(eqn.params.get("body_nconsts", 0))
+                ins = eqn.invars[cn + bn:]
+                outs = eqn.outvars
+            for i, (vi, vo) in enumerate(zip(ins, outs)):
+                ai, ao = getattr(vi, "aval", None), getattr(vo, "aval", None)
+                if ai is None or ao is None:
+                    continue
+                wi = bool(getattr(ai, "weak_type", False))
+                wo = bool(getattr(ao, "weak_type", False))
+                if wi != wo or _aval_dtype(vi) != _aval_dtype(vo):
+                    out.append(self.finding(
+                        Severity.WARNING,
+                        f"{site.primitive} carry {i} drifts "
+                        f"{_aval_dtype(vi)}/weak={wi} -> "
+                        f"{_aval_dtype(vo)}/weak={wo}; pin the carry dtype",
+                        site=site, rule="carry-drift"))
+        except Exception:  # noqa: BLE001 - param layout varies across jax
+            pass
+        return out

@@ -1,0 +1,53 @@
+"""Host-sync pass: no callbacks/transfers inside jitted programs.
+
+The "N MD steps = ONE device program" guarantee (PR 5) and the serving
+engine's latency model both die the moment a traced program stalls on the
+host: any ``pure_callback`` / ``io_callback`` / ``debug_callback`` /
+infeed / outfeed forces a device->host round trip per execution — inside a
+``while_loop`` body, once per iteration.
+
+Severities:
+
+- ERROR for every host-sync primitive inside a loop body (path contains
+  ``while``/``scan``) — and for ALL of them when the program is tagged
+  ``device_resident`` (the DeviceMD chunk's mandatory-zero rule);
+- ERROR for non-debug callbacks anywhere in a jitted program;
+- WARNING for ``debug_callback``/``debug_print`` outside loops (stray
+  debug prints still serialize dispatch, but don't change results).
+"""
+
+from __future__ import annotations
+
+from .. import ir
+from . import ContractPass, Program, Severity, register
+
+_LOOP_PRIMS = ("while", "scan")
+
+
+@register
+class HostSyncPass(ContractPass):
+    name = "host_sync"
+    description = ("no host callbacks/infeed/outfeed in device programs; "
+                   "mandatory-zero inside while_loop bodies")
+
+    def run(self, program: Program) -> list:
+        findings = []
+        resident = program.tagged("device_resident")
+        for site in ir.iter_sites(program.jaxpr):
+            prim = site.primitive
+            if not ir.is_host_sync(prim):
+                continue
+            in_loop = any(p in _LOOP_PRIMS for p in site.path)
+            debug = "debug" in prim
+            if in_loop:
+                sev, why = Severity.ERROR, "inside a device loop body"
+            elif resident:
+                sev, why = Severity.ERROR, "in a device-resident program"
+            elif debug:
+                sev, why = Severity.WARNING, "in a jitted program"
+            else:
+                sev, why = Severity.ERROR, "in a jitted program"
+            findings.append(self.finding(
+                sev, f"host-sync primitive {prim!r} {why}", site=site,
+                rule="loop" if in_loop else "jit"))
+        return findings

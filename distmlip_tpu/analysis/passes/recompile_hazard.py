@@ -1,0 +1,84 @@
+"""Recompile-hazard pass: values baked into the program that should be args.
+
+Two ways a jitted program quietly recompiles (or bloats) per call:
+
+- a large array closed over instead of passed as an argument is baked into
+  the executable as a constant — a new array object with the same values
+  re-traces nothing, but a *changed* value means a full recompile, and
+  either way the const is embedded in (and shipped with) every executable.
+  Static basis tables (spherical harmonics, Wigner blocks) are legitimate
+  consts; the size thresholds keep small tables silent and surface the
+  pathological ones (``config["const_warn_bytes"]`` default 256 KiB,
+  ``config["const_error_bytes"]`` default 4 MiB — audited exceptions:
+  ``# contract: allow(recompile_hazard)`` does not help here since consts
+  carry no source line; raise the threshold per program instead).
+- python scalars closed over become *weak-typed* scalar constants baked
+  per VALUE: ``jit(lambda x: x * step_count)`` re-traces for every new
+  ``step_count``. Reported as INFO with a count (heuristic — a static
+  hyperparameter is fine; a per-step value is not; the jaxpr cannot tell
+  them apart).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ir
+from . import ContractPass, Program, Severity, register
+
+
+def _nbytes(val, aval) -> int:
+    """Const payload size WITHOUT materializing the value: np.asarray on a
+    device-resident const would block on a device->host transfer, and this
+    pass also runs in the runtime telemetry path (calculator._contract_audit
+    promises a pure host-side walk)."""
+    try:
+        return int(np.prod(aval.shape) * aval.dtype.itemsize)
+    except Exception:  # noqa: BLE001 - aval without shape/dtype
+        nb = getattr(val, "nbytes", None)  # attr read, no transfer
+        return int(nb) if nb is not None else 0
+
+
+@register
+class RecompileHazardPass(ContractPass):
+    name = "recompile_hazard"
+    description = ("large closed-over consts baked into the executable; "
+                   "python-scalar (weak-type) constant promotion")
+
+    def run(self, program: Program) -> list:
+        warn = int(program.config.get("const_warn_bytes", 256 * 1024))
+        err = int(program.config.get("const_error_bytes", 4 * 1024 * 1024))
+        findings = []
+        total = 0
+        weak_scalars = 0
+        for val, aval in ir.program_consts(program.jaxpr):
+            nb = _nbytes(val, aval)
+            total += nb
+            shape = tuple(getattr(aval, "shape", ()))
+            if shape == () and bool(getattr(aval, "weak_type", False)):
+                weak_scalars += 1
+            if nb >= err:
+                findings.append(self.finding(
+                    Severity.ERROR,
+                    f"const {list(shape)} "
+                    f"{getattr(aval, 'dtype', '?')} = {nb / 2**20:.1f} MiB "
+                    "baked into the program — pass it as an argument (or "
+                    "raise const_error_bytes for an audited static table)",
+                    rule="giant-const"))
+            elif nb >= warn:
+                findings.append(self.finding(
+                    Severity.WARNING,
+                    f"const {list(shape)} "
+                    f"{getattr(aval, 'dtype', '?')} = {nb / 2**10:.0f} KiB "
+                    "baked into the program", rule="large-const"))
+        if weak_scalars:
+            findings.append(self.finding(
+                Severity.INFO,
+                f"{weak_scalars} weak-typed scalar const(s) — python "
+                "scalars closed over re-trace per distinct value",
+                rule="weak-scalar"))
+        findings.append(self.finding(
+            Severity.INFO,
+            f"total baked const payload: {total / 2**10:.0f} KiB",
+            rule="const-total"))
+        return findings

@@ -31,7 +31,7 @@ import numpy as np
 from ..parallel import make_batched_potential_fn
 from ..partition import BucketPolicy, pack_structures
 from ..telemetry import StepRecord, annotate
-from .atoms import (AMU_A2_FS2_TO_EV, EV_A3_TO_GPA, KB, Atoms, map_species,
+from .atoms import (AMU_A2_FS2_TO_EV, EV_A3_TO_GPA, KB, map_species,
                     max_displacement)
 from .relax import RelaxResult
 

@@ -828,19 +828,28 @@ class DistPotential:
                            max(self.num_partitions or 1, 1))
         except Exception:  # noqa: BLE001 - telemetry must never fail a step
             pass
-        rec.collective_count = self._collective_count()
+        rec.collective_count, rec.contract_error_count, \
+            rec.contract_warning_count = self._contract_audit()
         tel.emit(rec)
 
     def _collective_count(self) -> int:
         """Collectives per potential step (traced once per runtime build and
         cached — a host-side jaxpr walk, no device work). 0 when tracing is
         not possible (no cached graph yet)."""
+        return self._contract_audit()[0]
+
+    def _contract_audit(self) -> tuple:
+        """(collective_count, contract_errors, contract_warnings) of the
+        step program: ONE cached abstract trace per runtime build feeds
+        both the collective tally and every registered contract pass
+        (distmlip_tpu.analysis), so findings counts ride StepRecord for
+        free. (0, 0, 0) when tracing is not possible (no cached graph)."""
         cached = getattr(self, "_collective_count_cache", None)
         if cached is not None and cached[0] is self._potential:
             return cached[1]
         if (not self.collective_audit or self._cache is None
                 or self._potential is None):
-            return 0
+            return (0, 0, 0)
         try:
             import jax
 
@@ -851,9 +860,20 @@ class DistPotential:
                 self.params, graph, graph.positions)
             n = sum(count_collectives(jaxpr).values())
         except Exception:  # noqa: BLE001 - telemetry must never fail a step
-            n = 0
-        self._collective_count_cache = (self._potential, n)
-        return n
+            self._collective_count_cache = (self._potential, (0, 0, 0))
+            return (0, 0, 0)
+        try:
+            from ..analysis import (Program, error_count, run_passes,
+                                    warning_count)
+
+            findings = run_passes(Program(
+                name="step_program", jaxpr=jaxpr,
+                tags=frozenset({"grad"})))
+            out = (n, error_count(findings), warning_count(findings))
+        except Exception:  # noqa: BLE001 - a broken contract pass must not
+            out = (n, 0, 0)  # zero the collective tally too
+        self._collective_count_cache = (self._potential, out)
+        return out
 
     def partition_report(self, atoms: Atoms) -> str:
         """Partition-balance diagnostics (reference dist.py:704-721)."""

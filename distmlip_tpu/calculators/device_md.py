@@ -242,7 +242,8 @@ class DeviceMD:
     itself runs ON DEVICE inside the chunk loop — the whole trajectory is
     device-resident and the host only sees telemetry.
     ``device_rebuild="auto"`` inherits the potential's ``device_rebuild``
-    setting; an explicit True/False here overrides it. Otherwise (multi-partition meshes, CHGNet's bond graph, or
+    setting; an explicit True/False here overrides it. Otherwise
+    (multi-partition meshes, CHGNet's bond graph, or
     ``DISTMLIP_DEVICE_REBUILD=0``) the graph is rebuilt on the host when
     the skin criterion fires inside the device loop. Requires
     ``pot.skin > 0`` (the reuse radius defines the rebuild criterion).

@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from .atoms import EV_A3_TO_GPA, Atoms
+from .atoms import Atoms
 
 
 @dataclass

@@ -48,7 +48,6 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..ops import radial
 from ..ops.nn import (cast_params_subtrees, embedding, gated_mlp,
@@ -286,8 +285,14 @@ class CHGNet:
                 blk = params["bond_blocks"][i]
                 b = self._bond_node_conv(blk, lg, vx, b, a, tbw, line_ok)
                 e = lg.bond_to_edge(b, e)
-                _, (b,) = lg.exchange_all((), (b,))
-                a = self._angle_conv(blk, lg, vx, b, a, line_ok)
+                if i + 2 < cfg.num_blocks:
+                    # the refreshed b / updated a feed the NEXT block's bond
+                    # conv; after the last bond block nothing reads them, so
+                    # the exchange would be pure dead communication (XLA
+                    # can't DCE a collective) — the dead_compute contract
+                    # pass flags exactly this
+                    _, (b,) = lg.exchange_all((), (b,))
+                    a = self._angle_conv(blk, lg, vx, b, a, line_ok)
             else:
                 vx = lg.halo_exchange(v)
 

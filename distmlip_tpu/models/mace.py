@@ -390,6 +390,8 @@ class MACE:
 
         cfg = self.cfg
         if cfg.atomic_numbers is not None:
+            # cfg.atomic_numbers is a host config value, not a device array
+            # contract: allow(DML001)
             z_of = jnp.asarray(np.asarray(cfg.atomic_numbers, dtype=np.int32))
         else:
             z_of = jnp.arange(1, cfg.num_species + 1, dtype=jnp.int32)

@@ -1,14 +1,13 @@
-"""Collective-count auditing of traced programs.
+"""Collective-count auditing of traced programs — compatibility shim.
 
-The overlap-aware halo pipeline's first-order win is COUNT: one coalesced
-``ppermute`` per ring shift per sync point instead of one per (shift,
-array), and zero extra forwards for sitewise readouts. This module makes
-that measurable without a chip — it walks a traced jaxpr (recursing into
-pjit/remat/scan/cond sub-jaxprs) and tallies collective primitives, with a
-best-effort grouping by ``jax.named_scope`` name stacks so the per-layer
-structure is visible. Feeds the ``collective_count`` telemetry field, the
-jaxpr-level regression tests (tests/test_halo_overlap.py) and the
-``tools/halo_audit.py`` CLI.
+The jaxpr-walking machinery that used to live here is now
+:mod:`distmlip_tpu.analysis.ir` (one walker shared by every contract
+pass); this module keeps the historical audit API — the
+``collective_count`` telemetry field, the jaxpr-level regression tests
+(tests/test_halo_overlap.py, tests/test_mesh2d.py) and the
+``tools/halo_audit.py`` CLI all import from here and keep working
+unchanged. New invariants should be written as
+:class:`distmlip_tpu.analysis.ContractPass`es, not as new counters here.
 """
 
 from __future__ import annotations
@@ -17,52 +16,16 @@ from collections import Counter
 
 import jax
 
-# collective primitives the graph runtime can emit (names as they appear
-# in jaxprs across the jax versions this repo supports)
-COLLECTIVE_PRIMS = frozenset({
-    "ppermute", "psum", "psum2", "all_gather", "all_to_all",
-    "reduce_scatter", "pmax", "pmin", "pgather", "collective_permute",
-})
-
-
-def _iter_eqns(jaxpr):
-    """Yield every eqn in ``jaxpr`` and all nested sub-jaxprs."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for sub in _sub_jaxprs(eqn.params):
-            yield from _iter_eqns(sub)
-
-
-def _sub_jaxprs(params):
-    """Collect Jaxpr/ClosedJaxpr values from an eqn's params (fallback for
-    jax versions without jax.core.jaxprs_in_params)."""
-    out = []
-
-    def visit(v):
-        if hasattr(v, "eqns"):           # Jaxpr
-            out.append(v)
-        elif hasattr(v, "jaxpr"):        # ClosedJaxpr
-            out.append(v.jaxpr)
-        elif isinstance(v, (list, tuple)):
-            for x in v:
-                visit(x)
-
-    for v in params.values():
-        visit(v)
-    return out
-
-
-def count_collectives(closed_jaxpr) -> Counter:
-    """Counter of collective primitive name -> occurrence count over the
-    whole program (nested jaxprs included). scan bodies count ONCE per
-    trace — multiply by trip count yourself if you need dynamic totals."""
-    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
-    counts: Counter = Counter()
-    for eqn in _iter_eqns(jaxpr):
-        name = eqn.primitive.name
-        if name in COLLECTIVE_PRIMS:
-            counts[name] += 1
-    return counts
+from ..analysis.ir import (  # noqa: F401  (re-exported API)
+    COLLECTIVE_PRIMS,
+    collectives_by_axis,
+    count_collectives,
+    count_primitives,
+    eqn_axis_names as _eqn_axis_names,
+    iter_eqns as _iter_eqns,
+    is_host_sync,
+    sub_jaxprs as _sub_jaxprs,
+)
 
 
 def collective_counts(fn, *args, **kwargs) -> Counter:
@@ -77,77 +40,14 @@ def count_host_callbacks(closed_jaxpr) -> Counter:
     A program that should be fully device-resident (the DeviceMD chunk
     with its in-loop neighbor rebuild) must show an EMPTY counter: any
     ``pure_callback``/``io_callback``/infeed/outfeed would stall the
-    accelerator on the host mid-loop. Substring matching on "callback"
-    keeps this robust across jax versions' primitive renames."""
-    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    accelerator on the host mid-loop. The ``host_sync`` contract pass is
+    the registered form of this check."""
     counts: Counter = Counter()
-    for eqn in _iter_eqns(jaxpr):
+    for eqn in _iter_eqns(closed_jaxpr):
         name = eqn.primitive.name
-        if ("callback" in name or "infeed" in name or "outfeed" in name
-                or name == "host_local_array_to_global_array"):
+        if is_host_sync(name) and "debug_print" not in name:
             counts[name] += 1
     return counts
-
-
-def count_primitives(closed_jaxpr, names) -> Counter:
-    """Occurrences of specific primitive names (nested jaxprs included) —
-    e.g. ``{"while", "sort"}`` to assert a rebuild lowered INTO the MD
-    loop rather than around it."""
-    names = frozenset(names)
-    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
-    counts: Counter = Counter()
-    for eqn in _iter_eqns(jaxpr):
-        if eqn.primitive.name in names:
-            counts[eqn.primitive.name] += 1
-    return counts
-
-
-def _eqn_axis_names(eqn) -> tuple:
-    """Mesh axis names a collective eqn operates over, from its params.
-
-    Collective primitives carry the axis under different param names across
-    primitives and jax versions (``axis_name`` for ppermute/all_gather,
-    ``axes`` for psum/pmax, sometimes ``axis_index_groups`` alongside);
-    values may be a single name or a tuple. Returns ``("<unknown>",)`` when
-    no axis metadata is present.
-    """
-    for key in ("axis_name", "axes", "named_axes"):
-        val = eqn.params.get(key)
-        if val is None:
-            continue
-        if isinstance(val, (tuple, list, frozenset, set)):
-            named = tuple(v for v in val if isinstance(v, (str, int)))
-            if named or not val:
-                # an EMPTY axes tuple is a no-op psum (identity) the
-                # partial evaluator sometimes leaves behind — attribute it
-                # to no axis. A NON-empty tuple of unparseable axis objects
-                # must NOT vanish: fall through to "<unknown>" so the
-                # --mesh silence gate fails loudly instead of vacuously.
-                return named
-        elif isinstance(val, (str, int)):
-            return (val,)
-        break
-    return ("<unknown>",)
-
-
-def collectives_by_axis(closed_jaxpr) -> dict:
-    """``{axis_name: Counter(primitive -> count)}`` over the whole program.
-
-    The 2-D mesh invariant this feeds (``tools/halo_audit.py --mesh``): the
-    ``"batch"`` axis must carry ZERO collectives — batched structures are
-    block-diagonal, so all communication (halo ``ppermute``, readout
-    ``psum``) belongs to the ``"spatial"`` axis. A collective naming both
-    axes counts against both (it would already be a violation).
-    """
-    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
-    by_axis: dict[str, Counter] = {}
-    for eqn in _iter_eqns(jaxpr):
-        name = eqn.primitive.name
-        if name not in COLLECTIVE_PRIMS:
-            continue
-        for ax in _eqn_axis_names(eqn):
-            by_axis.setdefault(str(ax), Counter())[name] += 1
-    return by_axis
 
 
 def axis_collective_count(closed_jaxpr, axis_name: str) -> int:
@@ -161,9 +61,8 @@ def ppermutes_by_scope(closed_jaxpr) -> Counter:
     """Counter of name-stack string -> ppermute count (best effort: name
     stacks are source metadata and may be absent on some jax builds, in
     which case everything lands under "<unknown>")."""
-    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
     by_scope: Counter = Counter()
-    for eqn in _iter_eqns(jaxpr):
+    for eqn in _iter_eqns(closed_jaxpr):
         if eqn.primitive.name not in ("ppermute", "collective_permute"):
             continue
         try:

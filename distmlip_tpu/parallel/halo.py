@@ -340,23 +340,36 @@ class LocalGraph:
 
     # ---- bond-graph index remaps (reference dist.py:635-702 analogue) ----
     def edge_to_bond(self, edge_feats, bond_feats):
-        """Seed owned bond-node rows from their atom-graph edge features."""
+        """Seed owned bond-node rows from their atom-graph edge features.
+
+        ``bond_map_bond`` is ascending by construction (arange of owned
+        bonds per structure, block offsets ascending in the packed case)
+        and the mask sentinel ``b_cap`` exceeds every real id, so the
+        scatter rides the sorted fast path (scatter_hints contract).
+        """
         with scope("edge_to_bond"):
             vals = edge_feats[self.bond_map_edge]
             m = self.bond_map_mask
             vals = vals * m.astype(vals.dtype).reshape(
                 m.shape + (1,) * (vals.ndim - 1))
             idx = jnp.where(m, self.bond_map_bond, self.b_cap)
-            return bond_feats.at[idx].set(vals, mode="drop")
+            return bond_feats.at[idx].set(vals, mode="drop",
+                                          indices_are_sorted=True)
 
     def bond_to_edge(self, bond_feats, edge_feats):
-        """Write owned bond-node features back onto their edges."""
+        """Write owned bond-node features back onto their edges.
+
+        ``bond_map_edge`` is bond-node-ordered, NOT edge-ordered — the
+        scatter is legitimately unsorted (audited; sorting would need a
+        second, edge-ordered copy of the map pair in the graph layout).
+        """
         with scope("bond_to_edge"):
             vals = bond_feats[self.bond_map_bond]
             m = self.bond_map_mask
             vals = vals * m.astype(vals.dtype).reshape(
                 m.shape + (1,) * (vals.ndim - 1))
             idx = jnp.where(m, self.bond_map_edge, self.e_cap)
+            # contract: allow(scatter_hints)
             return edge_feats.at[idx].set(vals, mode="drop")
 
     # ---- reductions ----

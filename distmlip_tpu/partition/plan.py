@@ -61,7 +61,7 @@ class PartitionPlan:
     # bond graph (optional)
     has_bond_graph: bool = False
     bond_markers: list = field(default_factory=list)       # [p] -> (2P+2,)
-    bond_global_edge: list = field(default_factory=list)   # [p] -> (B_p,) global DE id per bond node
+    bond_global_edge: list = field(default_factory=list)   # [p] -> (B_p,) global DE ids
     bond_needs_in_line: list = field(default_factory=list) # [p] -> (B_p,) bool
     line_src: list = field(default_factory=list)           # [p] -> (L_p,) local bond ids
     line_dst: list = field(default_factory=list)

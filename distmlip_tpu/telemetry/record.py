@@ -80,6 +80,10 @@ class StepRecord:
     # --- halo pipeline + device-program cost model ---
     halo_mode: str = ""              # coalesced | legacy ("" = unknown)
     collective_count: int = 0        # collectives in the traced step program
+    # static contract audit of the step program (distmlip_tpu.analysis:
+    # one cached abstract trace per runtime build, all registered passes)
+    contract_error_count: int = 0    # unsuppressed ERROR findings
+    contract_warning_count: int = 0  # unsuppressed WARNING findings
     flops_per_step: float = 0.0      # analytic estimate (utils/flops.py)
     mfu: float = 0.0                 # flops / (device_s * devices * peak)
 

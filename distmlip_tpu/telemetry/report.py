@@ -220,6 +220,22 @@ def aggregate(
     colls = [r.collective_count for r in records if r.collective_count > 0]
     if colls:
         c["collective_count"] = max(colls)
+    # static contract audit (distmlip_tpu.analysis findings riding the
+    # records): any error-severity finding on a shipped step program is an
+    # anomaly — the program violates a stated runtime invariant
+    cerrs = [r.contract_error_count for r in records
+             if r.contract_error_count > 0]
+    cwarns = [r.contract_warning_count for r in records
+              if r.contract_warning_count > 0]
+    if cerrs or cwarns:
+        c["contract_errors"] = max(cerrs) if cerrs else 0
+        c["contract_warnings"] = max(cwarns) if cwarns else 0
+    if cerrs:
+        rep.anomalies.append(Anomaly(
+            "contract_errors", 0,
+            f"{max(cerrs)} error-severity contract finding(s) in the "
+            f"traced step program — run tools/contract_check.py for the "
+            f"findings table"))
     fr = [r.frontier_edge_frac for r in records if r.frontier_edge_frac > 0]
     if fr:
         c["mean_frontier_edge_frac"] = sum(fr) / len(fr)

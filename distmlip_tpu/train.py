@@ -9,8 +9,6 @@ graph parallelism doubles as data parallelism over space.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 

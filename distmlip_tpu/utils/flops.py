@@ -73,6 +73,9 @@ def chgnet_flops(cfg, n_atoms: float, n_edges: float, n_lines: float = 0.0,
         for _ in range(max(cfg.num_blocks - 1, 0)):
             f += _gated_mlp_flops([4 * C] + bh + [C], n_lines)  # bond conv
             f += _mlp_flops([C, C], n_bonds)                    # node_out
+        # the angle update after the LAST bond conv feeds nothing and is
+        # skipped (dead_compute contract pass)
+        for _ in range(max(cfg.num_blocks - 2, 0)):
             f += _gated_mlp_flops([4 * C] + gh + [C], n_lines)  # angle conv
     f += _mlp_flops([C] + fl + [1], n_atoms)              # final readout
     f += _mlp_flops([C, cfg.num_site_targets], n_atoms)   # sitewise

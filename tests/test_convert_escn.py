@@ -85,11 +85,14 @@ def synthetic_escn_state_dict():
             nl = LAY.m_size(m)
             _lin(sd, f"{bp}.so2_conv_2.so2_m_conv.{m - 1}.fc",
                  2 * nl * C, nl * H, bias=False)
-        sd[bp + ".ff_norm.affine_weight"] = 1.0 + 0.1 * torch.randn(LMAX + 1, C, dtype=torch.float64)
-        sd[bp + ".ff.so3_linear_1.weight"] = torch.randn(LMAX + 1, H, C, dtype=torch.float64) / np.sqrt(C)
+        sd[bp + ".ff_norm.affine_weight"] = 1.0 + 0.1 * torch.randn(
+            LMAX + 1, C, dtype=torch.float64)
+        sd[bp + ".ff.so3_linear_1.weight"] = torch.randn(
+            LMAX + 1, H, C, dtype=torch.float64) / np.sqrt(C)
         sd[bp + ".ff.so3_linear_1.bias"] = 0.1 * torch.randn(H, dtype=torch.float64)
         _lin(sd, bp + ".ff.gating_linear", LMAX * H, C)
-        sd[bp + ".ff.so3_linear_2.weight"] = torch.randn(LMAX + 1, C, H, dtype=torch.float64) / np.sqrt(H)
+        sd[bp + ".ff.so3_linear_2.weight"] = torch.randn(
+            LMAX + 1, C, H, dtype=torch.float64) / np.sqrt(H)
         sd[bp + ".ff.so3_linear_2.bias"] = 0.1 * torch.randn(C, dtype=torch.float64)
     sd["backbone.norm.affine_weight"] = 1.0 + 0.1 * torch.randn(LMAX + 1, C, dtype=torch.float64)
     _lin(sd, "heads.energy.mlp.0", C, C)

@@ -94,9 +94,12 @@ def test_coalesced_one_ppermute_per_exchange_round(rng, params):
 
     n = _ppermute_count(forward, params, graph, graph.positions)
     # exchange rounds for num_blocks=3 with bond graph: 1 fused init
-    # (v + bond geometry) + per inner block (2 of them): 1 fused (v + b)
-    # + 1 bond-only = 5; the final atom conv re-uses the last exchange
-    assert n == 5, f"expected 5 coalesced exchange rounds, traced {n}"
+    # (v + bond geometry) + per inner block (2 of them): 1 fused (v + b),
+    # plus 1 bond-only refresh feeding the SECOND block's angle conv — the
+    # last block's refresh/angle update feeds nothing and is skipped (dead
+    # communication, flagged by the dead_compute pass); the final atom conv
+    # re-uses the last exchange
+    assert n == 4, f"expected 4 coalesced exchange rounds, traced {n}"
 
     # every ppermute sits under a halo scope (no stray collectives)
     scopes = ppermutes_by_scope(jax.make_jaxpr(forward)(

@@ -1,0 +1,429 @@
+#!/usr/bin/env python
+"""Static program-contract checker: trace, run passes, gate CI.
+
+    python tools/contract_check.py [--models chgnet,tensornet,mace,escn]
+        [--programs SUBSTR] [--passes p1,p2] [--lint] [--only-lint]
+        [--list-passes] [--json] [--verbose]
+
+Builds small test systems, traces the REAL programs the runtime ships —
+for every model the forward total-energy and value_and_grad potential at
+placements (1,1) single-device, (2,1) graph-parallel ring and the (2,2)
+batch x spatial mesh, plus the device-resident DeviceMD chunk stepper and
+the single-partition packed-batch program — and runs every registered
+:class:`distmlip_tpu.analysis.ContractPass` over each jaxpr. No chip, no
+compile: the whole check is abstract tracing on CPU.
+
+Model programs are traced under ``jax.experimental.enable_x64`` so f64
+leaks stay visible instead of being silently canonicalized to f32 (the
+``dtype_discipline`` pass ignores weak-typed python scalars, so a clean
+fp32 program stays clean under x64).
+
+``--lint`` additionally runs the repo-specific AST lint
+(:mod:`distmlip_tpu.analysis.lint`) over the package + tools, and chains
+``ruff check`` (the generic pycodestyle/pyflakes/isort surface,
+``[tool.ruff]`` in pyproject.toml) when ruff is installed — one entry
+point for both. ``--only-lint`` skips the (slower) trace stage.
+
+Audited exceptions: ``# contract: allow(<pass-or-rule>)`` on the flagged
+source line (or the line above) downgrades that finding to suppressed —
+printed, but not gating.
+
+Exit codes: 0 clean, 2 usage error, 3 any unsuppressed ERROR finding.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# multi-device CPU mesh, set before jax initializes (same trick as tests)
+_flag = "--xla_force_host_platform_device_count=8"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+ALL_MODELS = ("chgnet", "tensornet", "mace", "escn")
+
+
+def build_system(reps, seed=0, a=3.5, n_species=2):
+    import numpy as np
+
+    from distmlip_tpu import geometry
+
+    rng = np.random.default_rng(seed)
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * a, reps)
+    cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+        0, 0.03, (len(frac), 3))
+    species = rng.integers(0, n_species, len(frac)).astype(np.int32)
+    return cart, lattice, species
+
+
+def make_model(name):
+    """Small-config instance of one of the four real models (plus the LJ
+    pair toy used by the DeviceMD program)."""
+    import jax
+
+    if name == "chgnet":
+        from distmlip_tpu.models.chgnet import CHGNet, CHGNetConfig
+
+        model = CHGNet(CHGNetConfig(
+            num_species=4, units=16, num_rbf=6, num_blocks=2,
+            cutoff=3.2, bond_cutoff=2.6))
+        use_bg, bond_r = True, 2.6
+    elif name == "tensornet":
+        from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+
+        model = TensorNet(TensorNetConfig(
+            num_species=4, units=16, num_rbf=8, num_layers=2, cutoff=3.2))
+        use_bg, bond_r = False, 0.0
+    elif name == "mace":
+        from distmlip_tpu.models import MACE, MACEConfig
+
+        model = MACE(MACEConfig(
+            num_species=4, channels=16, l_max=2, a_lmax=2, hidden_lmax=1,
+            correlation=3, num_interactions=2, num_bessel=6, radial_mlp=16,
+            cutoff=3.2, avg_num_neighbors=12.0))
+        use_bg, bond_r = False, 0.0
+    elif name == "escn":
+        from distmlip_tpu.models import ESCN, ESCNConfig
+
+        model = ESCN(ESCNConfig(
+            num_species=4, channels=16, l_max=2, num_layers=2, num_bessel=6,
+            num_experts=4, cutoff=3.2, avg_num_neighbors=12.0))
+        use_bg, bond_r = False, 0.0
+    elif name == "pair":
+        from distmlip_tpu.models.pair import PairConfig, PairPotential
+
+        model = PairPotential(PairConfig(cutoff=3.2, kind="lj"))
+        use_bg, bond_r = False, 0.0
+    else:
+        raise SystemExit(f"unknown model {name!r}")
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params, use_bg, bond_r
+
+
+def _graph_for(model, use_bg, bond_r, nparts, reps=(4, 2, 2)):
+    from distmlip_tpu.neighbors import neighbor_list_numpy
+    from distmlip_tpu.partition import build_partitioned_graph, build_plan
+
+    cart, lattice, species = build_system(reps)
+    r = model.cfg.cutoff
+    nl = neighbor_list_numpy(cart, lattice, [1, 1, 1], r, bond_r=bond_r)
+    plan = build_plan(nl, lattice, [1, 1, 1], nparts, r, bond_r, use_bg)
+    graph, _host = build_partitioned_graph(plan, nl, species, lattice)
+    return graph
+
+
+def _packed_graph(model, use_bg, bond_r, batch, spatial_parts=1,
+                  batch_parts=1):
+    import numpy as np
+
+    from distmlip_tpu.calculators import Atoms
+    from distmlip_tpu.partition import pack_structures
+
+    rng = np.random.default_rng(1)
+    # wide enough along x that `spatial_parts` slabs each exceed the cutoff
+    cart, lattice, species = build_system((max(2 * spatial_parts, 4), 2, 2))
+    base = Atoms(numbers=species + 1, positions=cart, cell=lattice)
+
+    def jittered():
+        a = base.copy()
+        a.positions = a.positions + rng.normal(0, 0.02, a.positions.shape)
+        return a
+
+    graph, _host = pack_structures(
+        [jittered() for _ in range(batch)], model.cfg.cutoff, bond_r,
+        use_bg, species_fn=lambda z: (z - 1).astype("int32"),
+        spatial_parts=spatial_parts, batch_parts=batch_parts)
+    return graph
+
+
+def _want_all(_name) -> bool:
+    return True
+
+
+def _trace_model_programs(name, programs_out, want=_want_all):
+    """Trace one model's program family across the three placements.
+
+    Forward (total-energy) programs carry the ``forward`` tag so the
+    scatter-hint contract bites; value_and_grad potentials are tagged
+    ``grad`` (the transposed gather legitimately emits unsorted
+    scatter-adds). All are traced under x64 (tag ``x64``).
+    ``want(program_name)`` gates each trace BEFORE the work happens, so a
+    ``--programs`` filter actually skips tracing, not just reporting.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import enable_x64
+
+    from distmlip_tpu.analysis import Program
+    from distmlip_tpu.parallel import (BATCH_AXIS, device_mesh, graph_mesh,
+                                       make_batched_potential_fn,
+                                       make_potential_fn, make_total_energy)
+
+    names_22 = (f"batched[{name}][2x2]",)
+    wanted_11 = [n for n in (f"energy[{name}][1x1]",
+                             f"potential[{name}][1x1]") if want(n)]
+    wanted_21 = [n for n in (f"energy[{name}][2x1]",
+                             f"potential[{name}][2x1]") if want(n)]
+    wanted_22 = [n for n in names_22 if want(n)]
+    if not (wanted_11 or wanted_21 or wanted_22):
+        return
+
+    model, params, use_bg, bond_r = make_model(name)
+    zero_strain = jnp.zeros((3, 3), np.float32)
+
+    # a grad program's replicated strain input transposes to ONE psum over
+    # every mesh axis — audited (the batch extent is 1 on all DistPotential
+    # placements, so it moves no bytes); see collective_placement docs
+    strain_cotangent = {"axis_budget": {BATCH_AXIS: {"psum": 1}}}
+    placements = []
+    if wanted_11:
+        placements.append(
+            ("1x1", None, _graph_for(model, use_bg, bond_r, 1),
+             {"max_total_collectives": 0}, {}))
+    if wanted_21:
+        placements.append(
+            ("2x1", graph_mesh(2), _graph_for(model, use_bg, bond_r, 2),
+             {"forbidden_axes": [BATCH_AXIS]}, strain_cotangent))
+    with enable_x64():
+        for tag, mesh, graph, coll_cfg, grad_cfg in placements:
+            mesh_tag = {"mesh"} if mesh is not None else set()
+            if want(f"energy[{name}][{tag}]"):
+                efn = make_total_energy(model.energy_fn, mesh)
+                jx = jax.make_jaxpr(efn)(params, graph, graph.positions,
+                                         zero_strain)
+                programs_out.append(Program(
+                    name=f"energy[{name}][{tag}]", jaxpr=jx,
+                    tags=frozenset({"forward", "x64"} | mesh_tag),
+                    config=dict(coll_cfg)))
+            if want(f"potential[{name}][{tag}]"):
+                pfn = make_potential_fn(model.energy_fn, mesh)
+                jx = jax.make_jaxpr(pfn)(params, graph, graph.positions)
+                programs_out.append(Program(
+                    name=f"potential[{name}][{tag}]", jaxpr=jx,
+                    tags=frozenset({"grad", "x64"} | mesh_tag),
+                    config={**coll_cfg, **grad_cfg}))
+
+        if wanted_22:
+            # (2,2): batch x spatial mesh over a 2-structure pack
+            mesh22 = device_mesh(2, 2)
+            g22 = _packed_graph(model, use_bg, bond_r, batch=2,
+                                spatial_parts=2, batch_parts=2)
+            bfn = make_batched_potential_fn(model.energy_fn, mesh=mesh22)
+            jx = jax.make_jaxpr(bfn)(params, g22, g22.positions)
+            programs_out.append(Program(
+                name=f"batched[{name}][2x2]", jaxpr=jx,
+                tags=frozenset({"grad", "mesh", "x64"}),
+                config={"forbidden_axes": [BATCH_AXIS]}))
+
+
+def _trace_packed_batch(programs_out):
+    """Single-partition packed-batch program (B=4): communication-free by
+    construction — batching adds structures, not collectives."""
+    import jax
+    from jax.experimental import enable_x64
+
+    from distmlip_tpu.analysis import Program
+    from distmlip_tpu.parallel import make_batched_potential_fn
+
+    model, params, use_bg, bond_r = make_model("tensornet")
+    graph = _packed_graph(model, use_bg, bond_r, batch=4)
+    bfn = make_batched_potential_fn(model.energy_fn)
+    with enable_x64():
+        jx = jax.make_jaxpr(bfn)(params, graph, graph.positions)
+    programs_out.append(Program(
+        name="packed_batch[tensornet][B=4]", jaxpr=jx,
+        tags=frozenset({"grad", "x64"}),
+        config={"max_total_collectives": 0}))
+
+
+def _trace_device_md(programs_out):
+    """The DeviceMD chunk stepper with the in-loop neighbor rebuild:
+    N steps = ONE device program, mandatory-zero host syncs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distmlip_tpu.analysis import Program
+    from distmlip_tpu.calculators import Atoms, DeviceMD, DistPotential
+
+    model, params, _bg, _br = make_model("pair")
+    cart, lattice, _species = build_system((3, 3, 3), a=3.8)
+    atoms = Atoms(numbers=np.full(len(cart), 14), positions=cart,
+                  cell=lattice)
+    pot = DistPotential(model, params, num_partitions=1, skin=0.4)
+    md = DeviceMD(pot, atoms, timestep=1.0)
+    graph, host, positions = pot._prepare(atoms)
+    md._ensure_spec(graph)
+    dtype = np.asarray(graph.lattice).dtype
+    ref = host.scatter_global(pot._cache[3].astype(dtype), graph.n_cap)
+    vel = host.scatter_global(atoms.velocities.astype(dtype), graph.n_cap)
+    masses = host.scatter_global(atoms.masses.astype(dtype), graph.n_cap,
+                                 fill=1.0)
+    jx = jax.make_jaxpr(md._dev_stepper)(
+        pot.params, graph, positions, ref, vel, masses, jnp.int32(8),
+        jnp.float32(0.0), jnp.float32(0.0))
+    programs_out.append(Program(
+        name="device_md[pair][1x1]", jaxpr=jx,
+        tags=frozenset({"grad", "device_resident"}),
+        config={"max_total_collectives": 0}))
+
+
+def run_lint(paths=None):
+    """Repo-specific AST lint + ruff (when installed) over the package."""
+    from distmlip_tpu.analysis import lint_paths
+
+    paths = paths or [os.path.join(REPO, "distmlip_tpu"),
+                      os.path.join(REPO, "tools")]
+    findings = lint_paths(paths, package_root=REPO)
+    ruff_report = None
+    ruff = shutil.which("ruff")
+    if ruff is not None:
+        proc = subprocess.run(
+            [ruff, "check", "--no-cache", *paths], cwd=REPO,
+            capture_output=True, text=True)
+        ruff_report = {"returncode": proc.returncode,
+                       "stdout": proc.stdout.strip()}
+    return findings, ruff_report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="contract_check", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--models", default=",".join(ALL_MODELS),
+                    help="comma list from {chgnet,tensornet,mace,escn}")
+    ap.add_argument("--programs", default=None,
+                    help="only check programs whose name contains SUBSTR")
+    ap.add_argument("--passes", default=None,
+                    help="comma list of registered passes (default: all)")
+    ap.add_argument("--lint", action="store_true",
+                    help="also run the AST lint (+ruff when installed)")
+    ap.add_argument("--only-lint", action="store_true",
+                    help="skip the trace stage, lint only")
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print INFO findings too")
+    try:
+        args = ap.parse_args(argv)
+        models = tuple(m.strip() for m in args.models.split(",") if m.strip())
+        bad = [m for m in models if m not in ALL_MODELS]
+        if bad:
+            raise ValueError(f"unknown model(s) {bad}; pick from "
+                             f"{list(ALL_MODELS)}")
+    except SystemExit as e:
+        # argparse already printed its usage + error message
+        return 0 if e.code in (0, None) else 2
+    except ValueError as e:
+        print(f"usage error: {e}", file=sys.stderr)
+        return 2
+
+    from distmlip_tpu.analysis import (Severity, clear_suppression_cache,
+                                       error_count, exit_code,
+                                       format_findings, get_passes, REGISTRY,
+                                       run_passes, warning_count)
+
+    # suppression comments are cached per file for the process lifetime;
+    # a fresh CLI run must re-read them (in-process callers like the tests
+    # may have edited sources since the cache filled)
+    clear_suppression_cache()
+
+    if args.list_passes:
+        for name, cls in REGISTRY.items():
+            print(f"{name:<22} {cls.description}")
+        return 0
+
+    try:
+        passes = get_passes(
+            None if args.passes is None
+            else [p.strip() for p in args.passes.split(",") if p.strip()])
+    except KeyError as e:
+        print(f"usage error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    report = {"programs": {}, "passes": [p.name for p in passes]}
+    all_findings = []
+
+    if not args.only_lint:
+        want = (_want_all if not args.programs
+                else (lambda n: args.programs in n))
+        programs = []
+        for name in models:
+            _trace_model_programs(name, programs, want)
+        if want("packed_batch[tensornet][B=4]"):
+            _trace_packed_batch(programs)
+        if want("device_md[pair][1x1]"):
+            _trace_device_md(programs)
+        for prog in programs:
+            findings = run_passes(prog, passes)
+            all_findings.extend(findings)
+            report["programs"][prog.name] = {
+                "errors": error_count(findings),
+                "warnings": warning_count(findings),
+                "findings": [f.render() for f in findings],
+            }
+            if not args.json:
+                shown = findings if args.verbose else [
+                    f for f in findings if f.severity != Severity.INFO]
+                print(format_findings(
+                    shown, header=f"{prog.name}  "
+                    f"[errors={error_count(findings)} "
+                    f"warnings={warning_count(findings)}]"))
+
+    if args.lint or args.only_lint:
+        lint_findings, ruff_report = run_lint()
+        all_findings.extend(lint_findings)
+        report["lint"] = {
+            "errors": error_count(lint_findings),
+            "findings": [f.render() for f in lint_findings],
+        }
+        if not args.json:
+            print(format_findings(lint_findings, header="lint"))
+        if ruff_report is not None:
+            report["lint"]["ruff"] = ruff_report
+            if not args.json and ruff_report["returncode"] != 0:
+                print("ruff:")
+                print(ruff_report["stdout"])
+        elif not args.json:
+            print("ruff: not installed, skipped (AST lint still ran)")
+        if ruff_report is not None and ruff_report["returncode"] != 0:
+            # represent ruff failures as one error so the exit gate fires
+            report["lint"]["errors"] += 1
+            all_findings.append(_ruff_finding(ruff_report))
+
+    n_err = error_count(all_findings)
+    n_warn = warning_count(all_findings)
+    report["errors"], report["warnings"] = n_err, n_warn
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        n_prog = len(report["programs"])
+        print(f"contract check: {n_prog} program(s), {len(passes)} pass(es)"
+              f"{', lint' if args.lint or args.only_lint else ''} -> "
+              f"{n_err} error(s), {n_warn} warning(s)")
+    return exit_code(all_findings)
+
+
+def _ruff_finding(ruff_report):
+    from distmlip_tpu.analysis import Finding, Severity
+
+    return Finding(pass_name="lint", severity=Severity.ERROR,
+                   message="ruff check failed:\n" + ruff_report["stdout"],
+                   rule="ruff")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

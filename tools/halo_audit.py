@@ -165,6 +165,13 @@ def main(argv=None) -> int:
             entry["ppermutes_by_scope"] = dict(ppermutes_by_scope(jaxpr))
         report["programs"][name] = entry
 
+    # both gates below run as the registered collective_placement contract
+    # pass (distmlip_tpu.analysis) — the CLI only builds Program configs
+    # and maps error findings to the historical exit code 3
+    from distmlip_tpu.analysis import Program, error_count, get_passes, run_passes
+
+    coll_pass = get_passes(["collective_placement"])
+
     batch_ok = True
     if args.batch > 0:
         from distmlip_tpu.calculators import Atoms
@@ -180,17 +187,27 @@ def main(argv=None) -> int:
             return a
 
         bfn = make_batched_potential_fn(model.energy_fn)
-        totals = {}
+        ref_total = None
         for B in sorted({1, args.batch}):
             bgraph, _ = pack_structures(
                 [jittered() for _ in range(B)], model.cfg.cutoff, bond_r,
                 use_bg, species_fn=lambda z: (z - 1).astype("int32"))
             jaxpr = jax.make_jaxpr(bfn)(params, bgraph, bgraph.positions)
             counts = count_collectives(jaxpr)
-            totals[B] = sum(counts.values())
+            total = sum(counts.values())
+            # counts must be INDEPENDENT of B: pin every B to the first
+            # (smallest) batch's total via the exact-equality gate
+            cfg = ({} if ref_total is None
+                   else {"expected_total_collectives": ref_total})
+            findings = run_passes(
+                Program(name=f"batched[B={B}]", jaxpr=jaxpr, config=cfg),
+                coll_pass)
+            if error_count(findings):
+                batch_ok = False
+            if ref_total is None:
+                ref_total = total
             report["programs"][f"batched[B={B}]"] = {
-                "total": totals[B], **dict(counts)}
-        batch_ok = len(set(totals.values())) == 1
+                "total": total, **dict(counts)}
         report["batched_collectives_independent_of_B"] = batch_ok
 
     mesh_ok = True
@@ -202,6 +219,7 @@ def main(argv=None) -> int:
                                            device_mesh, graph_mesh,
                                            make_batched_potential_fn,
                                            make_potential_fn)
+        from distmlip_tpu.analysis.ir import ppermute_count
         from distmlip_tpu.parallel.audit import collectives_by_axis
         from distmlip_tpu.partition import build_partitioned_graph as _bpg
         from distmlip_tpu.partition import build_plan as _bp
@@ -235,17 +253,20 @@ def main(argv=None) -> int:
         by_axis = {ax: dict(cnt)
                    for ax, cnt in collectives_by_axis(jaxpr_m).items()}
         batch_coll = sum(by_axis.get(BATCH_AXIS, {}).values())
-        mesh_pp = by_axis.get(SPATIAL_AXIS, {}).get("ppermute", 0)
-        # collectives whose axis metadata could not be parsed (a jax
-        # version changing the eqn param names) would make the gate pass
-        # VACUOUSLY — count them as a violation, not a pass
+        mesh_pp = ppermute_count(by_axis.get(SPATIAL_AXIS, {}))
         unattributed = sum(by_axis.get("<unknown>", {}).values())
         entry = {"total": sum(sum(c.values()) for c in by_axis.values()),
                  "by_axis": by_axis, "batch_axis_collectives": batch_coll,
                  "spatial_ppermutes": mesh_pp,
                  "unattributed_collectives": unattributed}
-        # 1-D ring reference at P=S on ONE copy of the same system: the
-        # packed placement must pay exactly the ring's ppermutes, no more
+        # the 2-D mesh invariants, stated as collective_placement config:
+        # ZERO collectives on the batch axis, nothing unattributed (a jax
+        # version changing the eqn param names must fail loudly, never
+        # pass vacuously), and at S > 1 spatial ppermute parity with the
+        # 1-D graph-parallel ring at P=S on ONE copy of the same system
+        # (packing adds structures, not communication)
+        mesh_cfg = {"forbidden_axes": [BATCH_AXIS],
+                    "require_attributed": True}
         if S_m > 1:
             nl_m = neighbor_list_numpy(cart_m, lat_m, [1, 1, 1], r,
                                        bond_r=bond_r)
@@ -255,16 +276,18 @@ def main(argv=None) -> int:
             jaxpr_r = jax.make_jaxpr(ring_fn)(params, graph_m,
                                               graph_m.positions)
             ring_axes = collectives_by_axis(jaxpr_r)
-            ring_pp = ring_axes.get(SPATIAL_AXIS, {}).get("ppermute", 0)
+            ring_pp = ppermute_count(ring_axes.get(SPATIAL_AXIS, {}))
             entry["ring_ppermutes_1d"] = ring_pp
-            mesh_ok = (batch_coll == 0 and unattributed == 0
-                       and mesh_pp == ring_pp)
+            mesh_cfg["expected_ppermutes"] = {SPATIAL_AXIS: ring_pp}
             mesh_detail = (f"batch_collectives={batch_coll} "
                            f"spatial_ppermutes={mesh_pp} (1-D ring: "
                            f"{ring_pp})")
         else:
-            mesh_ok = batch_coll == 0 and unattributed == 0
             mesh_detail = f"batch_collectives={batch_coll}"
+        mesh_findings = run_passes(
+            Program(name=f"mesh[{B_m}x{S_m}]", jaxpr=jaxpr_m,
+                    config=mesh_cfg), coll_pass)
+        mesh_ok = not error_count(mesh_findings)
         if unattributed:
             mesh_detail += f" UNATTRIBUTED={unattributed}"
         report["programs"][f"mesh[{B_m}x{S_m}]"] = entry

@@ -18,6 +18,11 @@ poison injection forces some — but the thread must still be serving), and
 ``drain()`` must leave the queue empty with every Future resolved.
 Exit codes: 0 ok, 3 check failed, 2 usage.
 
+``--contracts`` additionally traces the engine's batched potential (the
+exact program the scheduler dispatches) and runs every registered
+``distmlip_tpu.analysis`` contract pass over the jaxpr; combined with
+``--check``, an error-severity finding fails the gate.
+
 Smoke (verify flow): ``python tools/load_test.py --requests 12 --check``
 (~seconds on CPU with the default pair model).
 """
@@ -165,6 +170,34 @@ def run(args) -> int:
         telemetry.close()
         summary["jsonl"] = args.jsonl
 
+    contract_errors = None
+    if args.contracts:
+        # static contract audit of the SERVING program: trace the same
+        # batched potential the engine dispatches through over a
+        # representative packed pool batch and run every registered
+        # analysis pass (distmlip_tpu.analysis) — the scheduler must never
+        # ship a program that breaks the collective/host-sync/dtype/
+        # scatter-hint contracts
+        import jax
+
+        from distmlip_tpu.analysis import Program, error_count, run_passes
+
+        if pot._cache is not None:
+            # the exact packed graph the engine last dispatched through
+            sgraph = pot._cache[0]
+        else:
+            sgraph = pot._build(pool[:min(len(pool), args.max_batch)])[0]
+        jaxpr = jax.make_jaxpr(pot._potential)(
+            params, sgraph, sgraph.positions)
+        findings = run_passes(Program(
+            name="serving_program", jaxpr=jaxpr,
+            tags=frozenset({"grad"}),
+            config={"max_total_collectives": 0}))
+        contract_errors = error_count(findings)
+        summary["contract_errors"] = contract_errors
+        summary["contract_findings"] = [
+            f.render() for f in findings if not f.suppressed][:20]
+
     if args.check:
         # BucketPolicy compile bound: node/edge rungs over the pool's size
         # spread, times the few batch-slot powers of two in play
@@ -183,6 +216,8 @@ def run(args) -> int:
             "compile_bound": engine.compile_count <= bound,
             "drained_clean": bool(drained) and depth_after_drain == 0,
         }
+        if contract_errors is not None:
+            checks["contracts"] = contract_errors == 0
         summary["checks"] = checks
         summary["compile_bound"] = bound
         if not all(checks.values()):
@@ -217,6 +252,11 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--check", action="store_true",
                    help="assert acceptance criteria; exit 3 on failure")
+    p.add_argument("--contracts", action="store_true",
+                   help="also run the static contract passes "
+                        "(distmlip_tpu.analysis) over the serving program; "
+                        "with --check, any error-severity finding fails "
+                        "the gate")
     p.add_argument("--occupancy-floor", type=float, default=0.95)
     args = p.parse_args(argv)
     return run(args)

@@ -13,8 +13,6 @@ import json
 import os
 import sys
 
-import numpy as np
-
 _here = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_here))
 sys.path.insert(0, _here)  # for bench_common

@@ -680,6 +680,41 @@ def _main_measured():
                 dmd_extras["device_rebuild_atoms"] = n_d
         except Exception as e:  # noqa: BLE001 - device-MD bench is additive
             dmd_extras["device_md_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # --- fused-kernel microbench (PR 8): fused vs unfused edge-aggregate
+    # at a sweep of (E, width), MFU via the shared analytic FLOP count so
+    # the Pallas win is RECORDED in BENCH_*.json, not asserted.
+    # BENCH_KERNELS=0 skips.
+    kern_extras = {}
+    if os.environ.get("BENCH_KERNELS", "1") != "0":
+        k_budget = float(os.environ.get("BENCH_KERNELS_TIMEOUT_S", "420"))
+        watchdog.phase(
+            f"fused-kernel microbench exceeded {k_budget:.0f}s", k_budget)
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from kernel_bench import run_sweep as _kernel_sweep
+
+            k_sizes = [int(s) for s in os.environ.get(
+                "BENCH_KERNELS_E", "100000,400000").split(",") if s]
+            k_widths = [int(s) for s in os.environ.get(
+                "BENCH_KERNELS_W", "64,128").split(",") if s]
+            k_iters = int(os.environ.get("BENCH_KERNELS_ITERS", "20"))
+            # real Pallas on TPU backends; interpreter kernels are a test
+            # lane, not a benchmark — on CPU hosts record the unfused
+            # numbers only unless explicitly forced
+            on_tpu = jax.default_backend() == "tpu"
+            if on_tpu or os.environ.get("BENCH_KERNELS_INTERPRET") == "1":
+                kern_extras["kernel_bench"] = _kernel_sweep(
+                    k_sizes, k_widths, iters=k_iters, interpret=not on_tpu)
+            else:
+                kern_extras["kernel_bench"] = {
+                    "skipped": "no TPU backend (interpreter kernels are "
+                               "not a benchmark; BENCH_KERNELS_INTERPRET=1 "
+                               "forces the plumbing smoke)"}
+        except Exception as e:  # noqa: BLE001 - kernel bench is additive
+            kern_extras["kernel_bench_error"] = (
+                f"{type(e).__name__}: {e}"[:160])
     watchdog.finish()  # from here on the watchdog cannot print
     dt = float(np.median(watchdog.times))
     atoms_per_sec = len(atoms) / dt / max(len(jax.devices()), 1)
@@ -688,7 +723,7 @@ def _main_measured():
     # its A/B counterpart (host-side jaxpr traces — no device work), plus
     # the analytic-FLOP mfu for the measured steps
     extras = {"halo_mode": halo_mode, **batched_extras, **serve_extras,
-              **mesh_extras, **dmd_extras}
+              **mesh_extras, **dmd_extras, **kern_extras}
     try:
         from distmlip_tpu.parallel import make_potential_fn
         from distmlip_tpu.parallel.audit import count_collectives

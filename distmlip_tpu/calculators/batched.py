@@ -81,6 +81,7 @@ class BatchedPotential:
         num_threads: int | None = None,
         device_rebuild: bool | str = "auto",
         mesh=None,
+        kernels=None,
         telemetry=None,
     ):
         self.model = model
@@ -107,11 +108,20 @@ class BatchedPotential:
         self.skin = float(skin)
         self.num_threads = num_threads
         self.telemetry = telemetry
+        # Pallas fused-kernel routing (kernels/dispatch): None = backend
+        # default, False = pure XLA, "interpret" = interpreter-mode kernels
+        self.kernels = kernels
         self._potential = make_batched_potential_fn(
             model.energy_and_aux_fn if self.compute_magmom
             else model.energy_fn,
             compute_stress=self.compute_stress, aux=self.compute_magmom,
-            mesh=self.mesh)
+            mesh=self.mesh, kernels=kernels)
+        # last OBSERVED kernel-dispatch tally: jit traces once per shape
+        # bucket, so the counter fills on compile steps and stays empty on
+        # cache hits — the last nonzero tally describes the executable
+        # every subsequent hit runs
+        self._kernel_mode = ""
+        self._kernel_coverage = 0.0
         self._cache = None  # (graph, host, [(numbers, cell, pbc)])
         self.rebuild_count = 0
         # device-resident packed refresh (partition.device_refresh_packed);
@@ -328,7 +338,13 @@ class BatchedPotential:
                 positions = self._put_positions(host, structures, dtype)
         t2 = time.perf_counter()
         with annotate("distmlip/batched_potential"):
-            out = self._potential(self.params, graph, positions)
+            from ..kernels.dispatch import counting
+
+            with counting() as kc:
+                out = self._potential(self.params, graph, positions)
+            if kc.total:  # a fresh trace happened (new shape bucket)
+                self._kernel_mode = kc.mode
+                self._kernel_coverage = kc.coverage
             # flat shard-major slots -> input structure order (identity for
             # the single-shard pack)
             slots = host.structure_slots
@@ -369,6 +385,8 @@ class BatchedPotential:
         # list, so its batch stats remain valid; refresh the real-count
         # fields anyway in case the stats dict is shared downstream
         self.last_stats["batch_size"] = len(structures)
+        self.last_stats["kernel_mode"] = self._kernel_mode
+        self.last_stats["kernel_coverage"] = self._kernel_coverage
         self.last_stats["rebuild_count"] = int(not reused)
         self.last_stats["rebuild_on_device"] = int(refreshed)
         self.last_stats["rebuild_overflow_count"] = self.rebuild_overflow_count
@@ -395,6 +413,8 @@ class BatchedPotential:
             rebuild_overflow_count=self.rebuild_overflow_count,
             structures_per_sec=(n_structures / total_s if total_s > 0
                                 else 0.0),
+            kernel_mode=self._kernel_mode,
+            kernel_coverage=self._kernel_coverage,
         )
         import dataclasses
 

@@ -156,6 +156,7 @@ class DistPotential:
         fused_site_readout: bool = True,
         collective_audit: bool = True,
         device_rebuild: bool | str = "auto",
+        kernels=None,
         telemetry=None,
     ):
         import jax
@@ -214,6 +215,16 @@ class DistPotential:
         from ..parallel.halo import validate_halo_mode
 
         self.halo_mode = validate_halo_mode(halo_mode)
+        # Pallas fused-kernel routing (kernels/dispatch.resolve_kernel_mode):
+        # None = env/backend default (Pallas on TPU, XLA elsewhere),
+        # False = force the pure-XLA path, "interpret" = interpreter-mode
+        # kernels (the chip-free test lane)
+        self.kernels = kernels
+        # last OBSERVED dispatch tally (filled when a calculate triggers a
+        # fresh jit trace; the audit trace can't see dispatch decisions on
+        # a warm pjit cache)
+        self._kernel_mode = ""
+        self._kernel_coverage = 0.0
         # collective_count telemetry: one extra ABSTRACT trace (make_jaxpr,
         # no compile) per runtime build, on the first record emit — a small
         # fraction of that build's compile cost, but disable for
@@ -302,13 +313,13 @@ class DistPotential:
         self._potential = make_potential_fn(
             self.model.energy_and_aux_fn if fused else self.model.energy_fn,
             self.mesh, compute_stress=self.compute_stress,
-            halo_mode=self.halo_mode, aux=fused,
+            halo_mode=self.halo_mode, aux=fused, kernels=self.kernels,
         )
         # legacy separate-forward readout only when the fused path is
         # unavailable or explicitly disabled
         self._site_fn = (
             make_site_fn(self.model.magmom_fn, self.mesh,
-                         halo_mode=self.halo_mode)
+                         halo_mode=self.halo_mode, kernels=self.kernels)
             if (self.compute_magmom and not fused) else None
         )
 
@@ -731,7 +742,13 @@ class DistPotential:
         graph, host, positions = self._prepare(atoms)
         t2 = time.perf_counter()
         with annotate("distmlip/potential"):
-            out = self._potential(self.params, graph, positions)
+            from ..kernels.dispatch import counting
+
+            with counting() as kc:
+                out = self._potential(self.params, graph, positions)
+            if kc.total:  # a fresh jit trace happened (new shape bucket)
+                self._kernel_mode = kc.mode
+                self._kernel_coverage = kc.coverage
             energy = float(out["energy"])
         forces = host.gather_owned(np.asarray(out["forces"]), len(atoms))
         stress = np.asarray(out["stress"])
@@ -760,6 +777,8 @@ class DistPotential:
             rebuild_on_device=int(
                 self._prepare_flags.get("rebuild_on_device", 0)),
             rebuild_overflow_count=self.rebuild_overflow_count,
+            kernel_mode=self._kernel_mode,
+            kernel_coverage=self._kernel_coverage,
         )
         self._emit_record("calculate", host,
                           total_s=time.perf_counter() - t_start)
@@ -828,8 +847,9 @@ class DistPotential:
                            max(self.num_partitions or 1, 1))
         except Exception:  # noqa: BLE001 - telemetry must never fail a step
             pass
-        rec.collective_count, rec.contract_error_count, \
-            rec.contract_warning_count = self._contract_audit()
+        (rec.collective_count, rec.contract_error_count,
+         rec.contract_warning_count, rec.kernel_mode,
+         rec.kernel_coverage) = self._contract_audit()
         tel.emit(rec)
 
     def _collective_count(self) -> int:
@@ -839,29 +859,52 @@ class DistPotential:
         return self._contract_audit()[0]
 
     def _contract_audit(self) -> tuple:
-        """(collective_count, contract_errors, contract_warnings) of the
-        step program: ONE cached abstract trace per runtime build feeds
-        both the collective tally and every registered contract pass
-        (distmlip_tpu.analysis), so findings counts ride StepRecord for
-        free. (0, 0, 0) when tracing is not possible (no cached graph)."""
+        """(collective_count, contract_errors, contract_warnings,
+        kernel_mode, kernel_coverage) of the step program: ONE cached
+        abstract trace per runtime build feeds the collective tally, every
+        registered contract pass (distmlip_tpu.analysis) AND the
+        fused-kernel dispatch tally (kernels/dispatch.counting — the
+        dispatch decision is made at trace time, so counting during the
+        audit trace measures exactly what the compiled program runs).
+        (0, 0, 0, "", 0.0) when tracing is not possible (no cached
+        graph)."""
         cached = getattr(self, "_collective_count_cache", None)
         if cached is not None and cached[0] is self._potential:
-            return cached[1]
+            out = cached[1]
+            if out[3] or not self._kernel_mode:
+                return out
+            # the cache predates the first observed dispatch tally (e.g.
+            # audit traced on a warm pjit cache before any fresh trace):
+            # refresh the kernel fields, keep the findings
+            out = out[:3] + (self._kernel_mode, self._kernel_coverage)
+            self._collective_count_cache = (self._potential, out)
+            return out
         if (not self.collective_audit or self._cache is None
                 or self._potential is None):
-            return (0, 0, 0)
+            # no cached graph to trace (skin=0 runs) — the observed
+            # dispatch tally is still authoritative
+            return (0, 0, 0, self._kernel_mode, self._kernel_coverage)
         try:
             import jax
 
+            from ..kernels.dispatch import counting
             from ..parallel.audit import count_collectives
 
             graph = self._cache[0]
-            jaxpr = jax.make_jaxpr(self._potential)(
-                self.params, graph, graph.positions)
+            with counting() as kc:
+                jaxpr = jax.make_jaxpr(self._potential)(
+                    self.params, graph, graph.positions)
             n = sum(count_collectives(jaxpr).values())
+            # a warm pjit cache short-circuits the audit trace before the
+            # dispatch code runs — fall back to the tally calculate()
+            # observed at the real jit-trace time
+            kmode, kcov = kc.mode, kc.coverage
+            if not kc.total:
+                kmode, kcov = self._kernel_mode, self._kernel_coverage
         except Exception:  # noqa: BLE001 - telemetry must never fail a step
-            self._collective_count_cache = (self._potential, (0, 0, 0))
-            return (0, 0, 0)
+            self._collective_count_cache = (
+                self._potential, (0, 0, 0, "", 0.0))
+            return (0, 0, 0, "", 0.0)
         try:
             from ..analysis import (Program, error_count, run_passes,
                                     warning_count)
@@ -869,9 +912,10 @@ class DistPotential:
             findings = run_passes(Program(
                 name="step_program", jaxpr=jaxpr,
                 tags=frozenset({"grad"})))
-            out = (n, error_count(findings), warning_count(findings))
+            out = (n, error_count(findings), warning_count(findings),
+                   kmode, kcov)
         except Exception:  # noqa: BLE001 - a broken contract pass must not
-            out = (n, 0, 0)  # zero the collective tally too
+            out = (n, 0, 0, kmode, kcov)  # zero the findings tally only
         self._collective_count_cache = (self._potential, out)
         return out
 

@@ -274,6 +274,11 @@ class DeviceMD:
         self._total_energy = make_total_energy(
             potential.model.energy_fn, potential.mesh,
             halo_mode=getattr(potential, "halo_mode", "coalesced"),
+            # inherit the potential's Pallas routing; the MD force program
+            # differentiates positions only, so the force-program policy
+            # applies (no weight cotangents riding the scan carry / mesh)
+            kernels=getattr(potential, "kernels", None),
+            kernels_diff_params=False,
         )
         if device_rebuild == "auto":
             # inherit the potential's opt-out (an explicit True/False to

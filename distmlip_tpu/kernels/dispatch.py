@@ -1,0 +1,624 @@
+"""Kernel dispatch: Pallas on TPU, pure XLA everywhere else.
+
+Every fused-kernel call site in the codebase goes through this module,
+never through :mod:`segment`/:mod:`so3` directly. The dispatcher owns
+
+- **routing**: trace-time selection of the Pallas kernel vs the
+  pure-XLA ops (``ops/segment.py`` semantics). Pallas runs on TPU
+  backends, under ``DISTMLIP_KERNELS=interpret`` (interpreter-mode
+  kernels — the chip-free test lane), or inside a
+  :func:`force_kernel_mode` context; the ``DISTMLIP_KERNELS=0`` kill
+  switch and per-object ``kernels=False`` force XLA. The decision is
+  static per trace — both paths ship from ONE code path with no model
+  forks.
+- **autodiff**: ``pallas_call`` has no transpose rule, so each fused op
+  carries a custom VJP. ``fused_segment_sum``'s backward is the sorted
+  gather ``g[segment_ids] * mask``; ``fused_edge_aggregate``'s backward
+  re-runs the per-edge compute in bounded chunks (a ``lax.scan``) so the
+  backward pass ALSO never materializes the ``(E, width)`` message
+  cotangent; ``fused_so2_conv``'s backward is the VJP of the XLA
+  reference (its operand is already chunk-bounded by the model's edge
+  scan). The transposed node-gathers emit unsorted scatter-adds — the
+  audited grad-program exemption of the ``scatter_hints`` contract pass.
+- **telemetry**: a trace-time counter (:func:`counting`) records how
+  many aggregation call sites routed to Pallas vs XLA; the runtime's
+  cached contract-audit trace snapshots it into ``StepRecord``'s
+  ``kernel_mode``/``kernel_coverage`` fields.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.segment import masked_segment_sum
+from .segment import pallas_edge_aggregate, pallas_segment_sum
+from .so3 import packed_m_layout, so2_conv_pallas, so2_conv_reference
+
+# node arrays larger than this are pre-gathered by XLA instead of riding
+# VMEM into the kernel for the in-kernel gather
+DEFAULT_VMEM_BUDGET = int(os.environ.get("DISTMLIP_KERNELS_VMEM",
+                                         2 * 1024 * 1024))
+# backward-pass edge chunk (bounds the message-cotangent working set)
+DEFAULT_BWD_CHUNK = int(os.environ.get("DISTMLIP_KERNELS_BWD_CHUNK", "32768"))
+
+_MODES = ("pallas", "interpret", "xla")
+_local = threading.local()
+
+
+@dataclass
+class KernelCounter:
+    """Trace-time tally of dispatch decisions (edge aggregations only)."""
+
+    pallas: int = 0
+    xla: int = 0
+
+    @property
+    def total(self) -> int:
+        return self.pallas + self.xla
+
+    @property
+    def coverage(self) -> float:
+        return self.pallas / self.total if self.total else 0.0
+
+    @property
+    def mode(self) -> str:
+        if self.total == 0:
+            return ""
+        return "pallas" if self.pallas > 0 else "xla"
+
+
+@dataclass
+class Gather:
+    """A deferred node-row gather input to :func:`fused_edge_aggregate`.
+
+    ``node`` is an (N, ...) array, ``idx`` the (E,) per-edge row indices.
+    On the Pallas path small node arrays ride VMEM whole and the gather
+    happens INSIDE the kernel; oversized ones (and the XLA fallback)
+    pre-gather with a plain XLA gather.
+    """
+
+    node: Any
+    idx: Any
+    # populated by dispatch: node flattened trailing shape restored in rows
+    trailing: tuple = field(default_factory=tuple)
+
+
+def force_kernel_mode(mode: str | None):
+    """Context manager pinning the dispatch decision for the current
+    thread: ``"pallas" | "interpret" | "xla" | None`` (None restores the
+    env/backend default). Used by the contract checker's ``--kernels``
+    flag and the parity tests."""
+
+    @contextmanager
+    def ctx():
+        if mode is not None and mode not in _MODES:
+            raise ValueError(f"mode={mode!r}: expected one of {_MODES}")
+        old = getattr(_local, "forced", None)
+        _local.forced = mode
+        try:
+            yield
+        finally:
+            _local.forced = old
+
+    return ctx()
+
+
+@contextmanager
+def counting():
+    """Collect this thread's dispatch decisions into a fresh counter
+    (nested uses shadow the outer counter)."""
+    old = getattr(_local, "counter", None)
+    c = KernelCounter()
+    _local.counter = c
+    try:
+        yield c
+    finally:
+        _local.counter = old
+
+
+def _count(used_pallas: bool) -> None:
+    c = getattr(_local, "counter", None)
+    if c is not None:
+        if used_pallas:
+            c.pallas += 1
+        else:
+            c.xla += 1
+
+
+def resolve_kernel_mode(kernels=None) -> str:
+    """Static (trace-time) routing decision.
+
+    Priority: :func:`force_kernel_mode` context > per-object ``kernels``
+    (``False`` -> xla, ``"interpret"``/``"pallas"``/``"xla"`` verbatim)
+    > ``DISTMLIP_KERNELS`` env (``0``/``off`` kill switch, ``interpret``,
+    ``1``/``on``) > backend default (pallas iff the default backend is
+    TPU). ``kernels=None``/``True`` both mean "backend default" — True
+    cannot force a compiled Pallas kernel onto a CPU host.
+    """
+    forced = getattr(_local, "forced", None)
+    if forced is not None:
+        return forced
+    if kernels is False:
+        return "xla"
+    if isinstance(kernels, str):
+        if kernels not in _MODES:
+            raise ValueError(f"kernels={kernels!r}: expected bool, None or "
+                             f"one of {_MODES}")
+        return kernels
+    env = os.environ.get("DISTMLIP_KERNELS", "auto").strip().lower()
+    if env in ("0", "off", "false", "xla"):
+        return "xla"
+    if env == "interpret":
+        return "interpret"
+    if env in ("1", "on", "force", "pallas"):
+        return "pallas"
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 - no backend yet: fall back to XLA
+        backend = "cpu"
+    return "pallas" if backend == "tpu" else "xla"
+
+
+def _mask_mul(rows, mask):
+    if mask is None:
+        return rows
+    m = mask.astype(rows.dtype)
+    return rows * m.reshape(m.shape + (1,) * (rows.ndim - m.ndim))
+
+
+def _int_zero(x):
+    """float0 cotangent for an integer/bool primal (custom_vjp contract)."""
+    import numpy as np
+
+    return np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
+
+
+# ---------------------------------------------------------------------------
+# fused segment sum
+# ---------------------------------------------------------------------------
+
+def fused_segment_sum(data, segment_ids, num_segments: int, mask=None,
+                      indices_are_sorted: bool = False, kernels=None):
+    """Dispatching drop-in for ``masked_segment_sum``.
+
+    Routes to the dst-tiled Pallas kernel when the layout contract holds
+    (``indices_are_sorted=True`` — the dst-tile slicing depends on it)
+    and the mode resolves to Pallas; identical masking/padding semantics
+    on both paths, custom VJP on the kernel path.
+    """
+    mode = resolve_kernel_mode(kernels)
+    # float (inexact) masks would need a real mask cotangent (the bwd
+    # returns float0) — all repo masks are boolean; float masks take the
+    # XLA path where plain AD handles them
+    float_mask = (mask is not None
+                  and jnp.issubdtype(jnp.result_type(mask), jnp.inexact))
+    use = (mode != "xla" and indices_are_sorted and not float_mask
+           and data.shape[0] > 0 and num_segments > 0)
+    _count(use)
+    if not use:
+        return masked_segment_sum(data, segment_ids, num_segments, mask,
+                                  indices_are_sorted=indices_are_sorted)
+    interpret = mode == "interpret"
+    # every traced operand is an EXPLICIT custom_vjp arg (ids/mask may be
+    # tracers of an enclosing scan/checkpoint body — closing over them
+    # would leak out of that trace when the backward replays); integer
+    # and bool primals get float0 cotangents. Under remat the replayed
+    # forward of this call can be fully dead (the bwd needs only ids/mask
+    # residuals); XLA DCEs the pure replay, no bytes ship:
+    # contract: allow(dead_compute)
+    return _segment_sum_vjp(num_segments, interpret,
+                            jnp.result_type(data))(data, segment_ids, mask)
+
+
+def _segment_sum_vjp(num_segments: int, interpret: bool, dtype):
+    # shape/dtype are trace-time statics: they ride the factory closure,
+    # NOT the custom_vjp residuals (residuals must be valid JAX types —
+    # they become scan carries when the call sits inside a scanned body)
+    @jax.custom_vjp
+    def f(d, ids, m):
+        return pallas_segment_sum(d, ids, num_segments, mask=m,
+                                  interpret=interpret)
+
+    def fwd(d, ids, m):
+        return f(d, ids, m), (ids, m)
+
+    def bwd(res, g):
+        ids, m = res
+        # transpose of a masked segment sum: the sorted per-edge gather
+        gd = jnp.take(g, ids, axis=0)
+        m_ct = None if m is None else _int_zero(m)
+        return (_mask_mul(gd, m).astype(dtype), _int_zero(ids), m_ct)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# fused gather -> edge compute -> scatter
+# ---------------------------------------------------------------------------
+
+def _jaxpr_call(jaxpr, n_rows: int):
+    """``fun(*rows, *consts)`` re-evaluating a traced edge_fn jaxpr with
+    its hoisted consts as explicit trailing arguments."""
+
+    def fun(*args):
+        rows, cs = args[:n_rows], args[n_rows:]
+        out = jax.core.eval_jaxpr(jaxpr, list(cs), *rows)
+        if len(out) != 1:
+            raise ValueError("edge_fn must return a single array")
+        return out[0]
+
+    return fun
+
+
+def _hoist(edge_fn, row_avals):
+    """Trace ``edge_fn`` at the given row shapes and hoist its closure
+    captures (weights, tables). Returns ``(jaxpr, raw_consts)`` — the
+    RAW captured objects, so two traces of the same function can be
+    matched by identity (the jaxpr's shapes are baked, and the kernel
+    and the chunked backward evaluate at different row counts)."""
+    closed = jax.make_jaxpr(edge_fn)(*row_avals)
+    return closed.jaxpr, list(closed.consts)
+
+
+def _match_consts(raw_fwd, raw_bwd):
+    """Position of each backward-trace const in the forward trace's const
+    list. Tracing one function at two leading-axis sizes walks the same
+    code path, so the captured objects are the same — anything else means
+    a shape-dependent branch inside edge_fn, where silently dropping a
+    cotangent would corrupt training grads: fail loudly instead."""
+    id2fwd = {id(c): i for i, c in enumerate(raw_fwd)}
+    perm = [id2fwd.get(id(c)) for c in raw_bwd]
+    if None in perm or len(set(perm)) != len(raw_fwd):
+        raise ValueError(
+            "fused_edge_aggregate: edge_fn's closure captures differ "
+            "between the kernel-block and backward-chunk traces (shape-"
+            "dependent capture set); pass kernels=False for this call "
+            "site or restructure edge_fn")
+    return perm
+
+
+def _rows_of(item):
+    """Materialize one input's per-edge rows (XLA path / backward)."""
+    if isinstance(item, Gather):
+        return jnp.take(jnp.asarray(item.node), item.idx, axis=0)
+    return jnp.asarray(item)
+
+
+def fused_edge_aggregate(edge_fn, inputs, segment_ids, num_segments: int,
+                         mask=None, indices_are_sorted: bool = True,
+                         kernels=None, diff_params: bool = True,
+                         vmem_budget: int | None = None,
+                         bwd_chunk: int | None = None):
+    """Fused gather + per-edge compute + dst-sorted segment sum.
+
+    ``inputs``: per-edge arrays ``(E, ...)`` and/or :class:`Gather`
+    markers. ``edge_fn(*rows) -> (E,) + out_shape`` messages; the result
+    is ``sum_{e: dst[e]=n} mask[e] * edge_fn(...)[e]`` with the exact
+    ``masked_segment_sum`` padding semantics. On the Pallas path the
+    message tensor only ever exists one ``(BLK, width)`` block at a time
+    in VMEM — forward AND backward (chunked custom VJP).
+
+    ``diff_params``: whether gradients flow into ``edge_fn``'s hoisted
+    float closure captures (edge-MLP weights). Training programs need
+    True (the default). Force/stress programs differentiate positions
+    only — they pass False, which stop-gradients the captures so the
+    custom VJP neither computes the (dead) weight cotangents nor emits
+    the replicated-input psums shard_map's transpose would otherwise
+    add for them (a custom_vjp marks every primal perturbed; without
+    this knob the kernel path would ship weight-gradient bytes over the
+    mesh on every force call that plain XLA AD never ships).
+    """
+    inputs = list(inputs)
+    mode = resolve_kernel_mode(kernels)
+    e = int(segment_ids.shape[0])
+    # float (inexact) masks would need a mask cotangent the chunked
+    # backward doesn't produce — every mask in this repo is boolean; a
+    # float mask routes to the XLA path where plain AD handles it
+    float_mask = (mask is not None
+                  and jnp.issubdtype(jnp.result_type(mask), jnp.inexact))
+    use = (mode != "xla" and indices_are_sorted and e > 0
+           and num_segments > 0 and not float_mask)
+    _count(use)
+    if not use:
+        msg = edge_fn(*[_rows_of(i) for i in inputs])
+        return masked_segment_sum(msg, segment_ids, num_segments, mask,
+                                  indices_are_sorted=indices_are_sorted)
+
+    interpret = mode == "interpret"
+    budget = DEFAULT_VMEM_BUDGET if vmem_budget is None else int(vmem_budget)
+    chunk = DEFAULT_BWD_CHUNK if bwd_chunk is None else int(bwd_chunk)
+
+    # oversized node arrays: pre-gather with XLA (the kernel's in-kernel
+    # gather wants the node array VMEM-resident)
+    prep = []
+    for item in inputs:
+        if isinstance(item, Gather):
+            node = jnp.asarray(item.node)
+            if node.size * node.dtype.itemsize > budget:
+                prep.append(_rows_of(item))
+            else:
+                prep.append(Gather(node, item.idx, node.shape[1:]))
+        else:
+            prep.append(jnp.asarray(item))
+
+    # per-edge row avals at an arbitrary leading size (the jaxpr shapes
+    # are baked, so the kernel traces at its block size and the backward
+    # at its chunk size)
+    def avals_at(n):
+        return [
+            jax.ShapeDtypeStruct((n,) + tuple(p.trailing), p.node.dtype)
+            if isinstance(p, Gather)
+            else jax.ShapeDtypeStruct((n,) + p.shape[1:], p.dtype)
+            for p in prep
+        ]
+
+    out_aval = jax.eval_shape(edge_fn, *avals_at(e))
+    out_shape, out_dtype = out_aval.shape[1:], out_aval.dtype
+
+    # hoist edge_fn's closure captures (edge-MLP weights, coupling tables)
+    # into explicit arrays: a Pallas kernel cannot capture array constants,
+    # and parameter captures must stay DIFFERENTIABLE (training grads flow
+    # through the per-edge compute). conv_fn(*rows, *consts) is edge_fn
+    # with its captures as trailing args; float consts become primal args
+    # of the custom VJP, integer tables stay constant. (jax.closure_convert
+    # hoists only TRACER captures — concrete weight arrays would stay baked
+    # in and trip pallas_call's no-captured-constants check — so the
+    # hoisting is done on an explicit jaxpr trace at the kernel's block
+    # granularity.)
+    from .segment import _pick_tiles
+
+    tn, eb = _pick_tiles(e, num_segments, None, None)
+    jaxpr_blk, raw_consts = _hoist(edge_fn, avals_at(eb))
+    consts = [jnp.asarray(c) for c in raw_consts]
+    if not diff_params:
+        # force-only program: cut the capture gradients here, INSIDE the
+        # shard-local function, so no weight-cotangent psum ever reaches
+        # the shard_map boundary
+        consts = [jax.lax.stop_gradient(c) for c in consts]
+    conv_fn = _jaxpr_call(jaxpr_blk, len(prep))
+    diff_cpos = [i for i, c in enumerate(consts)
+                 if jnp.issubdtype(c.dtype, jnp.inexact)]
+    n_in = len(prep)
+
+    def merged_consts(dconsts):
+        out = list(consts)
+        for i, d in zip(diff_cpos, dconsts):
+            out[i] = d
+        return out
+
+    # EVERY traced operand is an explicit custom_vjp primal — node/edge
+    # arrays, gather index columns, segment ids, the mask and the hoisted
+    # float consts. Closing over any of them would leak tracers out of an
+    # enclosing scan/remat body when the backward replays under
+    # higher-order AD (training differentiates THROUGH the force vjp).
+    idxs = [p.idx for p in prep if isinstance(p, Gather)]
+    n_idx = len(idxs)
+    has_mask = mask is not None
+
+    def split(args):
+        arrs = args[:n_in]
+        idxs_ = list(args[n_in:n_in + n_idx])
+        ids_ = args[n_in + n_idx]
+        m_ = args[n_in + n_idx + 1] if has_mask else None
+        dconsts = args[n_in + n_idx + 1 + int(has_mask):]
+        return arrs, idxs_, ids_, m_, dconsts
+
+    @jax.custom_vjp
+    def f(*args):
+        arrs, idxs_, ids_, m_, dconsts = split(args)
+        items = []
+        gi = 0
+        for p, a in zip(prep, arrs):
+            if isinstance(p, Gather):
+                items.append(("gather", a, idxs_[gi]))
+                gi += 1
+            else:
+                items.append(a)
+        return pallas_edge_aggregate(
+            conv_fn, items, ids_, num_segments, m_,
+            out_shape=out_shape, out_dtype=out_dtype,
+            consts=merged_consts(dconsts), tile_n=tn, edge_blk=eb,
+            interpret=interpret)
+
+    def f_fwd(*args):
+        return f(*args), args
+
+    def f_bwd(args, g):
+        arrs, idxs_, ids_, m_, dconsts = split(args)
+
+        def make_rowwise(chunk_n):
+            # re-trace at the backward's chunk granularity; the captures
+            # are matched BY IDENTITY to the forward trace so the float
+            # ones route through the custom-VJP args (grads flow)
+            jaxpr_bwd, raw_bwd = _hoist(edge_fn, avals_at(chunk_n))
+            perm = _match_consts(raw_consts, raw_bwd)
+            bwd_fn = _jaxpr_call(jaxpr_bwd, n_in)
+
+            def rowwise(rows, dconsts_):
+                merged = merged_consts(list(dconsts_))
+                return bwd_fn(*rows, *[merged[p] for p in perm])
+
+            return rowwise
+
+        in_cts, const_cts = _edge_aggregate_bwd(
+            make_rowwise, prep, arrs, dconsts, idxs_,
+            ids_, m_, g, chunk, diff_params)
+        out = in_cts + tuple(_int_zero(i) for i in idxs_)
+        out = out + (_int_zero(ids_),)
+        if has_mask:
+            out = out + (_int_zero(m_),)  # masks are bool/int (gated above)
+        return out + const_cts
+
+    f.defvjp(f_fwd, f_bwd)
+    diff = ([p.node if isinstance(p, Gather) else p for p in prep]
+            + idxs + [segment_ids] + ([mask] if has_mask else [])
+            + [consts[i] for i in diff_cpos])
+    # custom_vjp must return a cotangent for EVERY primal; when the
+    # enclosing transpose needs only some, the rest (including their
+    # scatter-adds) are dead and XLA DCEs them:
+    # contract: allow(dead_compute)
+    return f(*diff)
+
+
+def _edge_aggregate_bwd(make_rowwise, prep, arrs, dconsts, idxs,
+                        segment_ids, mask, g, chunk,
+                        diff_params: bool = True):
+    """Chunked backward: per edge chunk, re-run the per-edge compute under
+    ``jax.vjp`` against the gathered message cotangent ``g[dst] * mask``
+    and accumulate input cotangents — plain inputs stack per-chunk rows,
+    gathered node arrays scatter-add (the audited unsorted grad-program
+    scatter), hoisted float consts (edge-MLP weights) sum across chunks.
+    Working set is O(chunk * width), not O(E * width). With
+    ``diff_params=False`` the const cotangents are symbolic zeros (the
+    caller stop-gradients the captures; computing real cotangents here
+    would be pure dead work). Returns ``(input_cts, const_cts)``."""
+    e = int(segment_ids.shape[0])
+    chunk = max(1, min(chunk, e))
+    k = -(-e // chunk)
+    e_pad = k * chunk
+    pad = e_pad - e
+
+    def pad_rows(x, fill=0):
+        if pad == 0:
+            return x
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=fill)
+
+    ids_p = jnp.concatenate(
+        [segment_ids, jnp.broadcast_to(segment_ids[-1], (pad,))]
+    ) if pad else segment_ids
+    m = jnp.ones((e,), dtype=g.dtype) if mask is None else mask.astype(g.dtype)
+    m_p = pad_rows(m)
+
+    # per-edge xs streams: plain rows come from the primal arrays, gather
+    # inputs stream their idx column (node arrays stay closed over)
+    xs = [ids_p, m_p]
+    gi = 0
+    for p, a in zip(prep, arrs):
+        if isinstance(p, Gather):
+            xs.append(pad_rows(idxs[gi].astype(jnp.int32)))
+            gi += 1
+        else:
+            xs.append(pad_rows(a))
+    rowwise = make_rowwise(chunk)
+
+    def chunk_fn(carry, xs_c):
+        node_cts, const_cts = carry
+        ids_c, m_c, *per_edge = xs_c
+        rows = []
+        for p, a, col in zip(prep, arrs, per_edge):
+            rows.append(jnp.take(a, col, axis=0)
+                        if isinstance(p, Gather) else col)
+        gm = jnp.take(g, ids_c, axis=0)
+        gm = gm * m_c.reshape(m_c.shape + (1,) * (gm.ndim - 1))
+        if diff_params:
+            msg, vjp_fn = jax.vjp(rowwise, tuple(rows), tuple(dconsts))
+            row_cts, dc_cts = vjp_fn(gm.astype(msg.dtype))
+        else:
+            msg, vjp_fn = jax.vjp(
+                lambda rs: rowwise(rs, tuple(dconsts)), tuple(rows))
+            (row_cts,) = vjp_fn(gm.astype(msg.dtype))
+            dc_cts = tuple(jnp.zeros(c.shape, c.dtype) for c in dconsts)
+        new_node_cts = list(node_cts)
+        plain_out = []
+        gi = 0
+        for p, col, ct in zip(prep, per_edge, row_cts):
+            if isinstance(p, Gather):
+                # contract: allow(scatter_hints) — grad-path transpose of
+                # an unsorted gather (src order is not dst order)
+                new_node_cts[gi] = new_node_cts[gi].at[col].add(ct)
+                gi += 1
+            else:
+                plain_out.append(ct)
+        new_const_cts = (tuple(c0 + c for c0, c in zip(const_cts, dc_cts))
+                         if diff_params else const_cts)
+        return (tuple(new_node_cts), new_const_cts), tuple(plain_out)
+
+    node_cts0 = tuple(
+        jnp.zeros(a.shape, a.dtype)
+        for p, a in zip(prep, arrs) if isinstance(p, Gather))
+    const_cts0 = tuple(jnp.zeros(c.shape, c.dtype) for c in dconsts)
+
+    if k == 1:
+        (node_cts, const_cts), plain = chunk_fn(
+            (node_cts0, const_cts0), tuple(xs))
+        plain = [c[:e] for c in plain]
+    else:
+        xs_c = tuple(x.reshape((k, chunk) + x.shape[1:]) for x in xs)
+        (node_cts, const_cts), plain_stacked = jax.lax.scan(
+            chunk_fn, (node_cts0, const_cts0), xs_c)
+        plain = [c.reshape((e_pad,) + c.shape[2:])[:e]
+                 for c in plain_stacked]
+
+    out = []
+    gi = pi = 0
+    for p in prep:
+        if isinstance(p, Gather):
+            out.append(node_cts[gi])
+            gi += 1
+        else:
+            out.append(plain[pi])
+            pi += 1
+    return tuple(out), tuple(const_cts)
+
+
+# ---------------------------------------------------------------------------
+# fused SO(2) convolution (eSCN channel mixing)
+# ---------------------------------------------------------------------------
+
+def fused_so2_conv(h, weights, m_idx: dict, channels: int, kernels=None,
+                   diff_params: bool = True):
+    """SO(2) convolution over all |m| blocks, dispatched.
+
+    ``h``: (E, S, C) coefficients in the model's (e3nn) layout;
+    ``weights``: ``[W0, W1r, W1i, ...]`` mixed (d, d) matrices per m;
+    ``m_idx``: the model's per-|m| (plus, minus) index sets. Returns the
+    convolved coefficients in the SAME layout. On the Pallas path every
+    per-(l, m) GEMM runs in one VMEM-resident kernel; backward is the
+    VJP of the XLA reference (the operand is already chunk-bounded by
+    the model's edge scan). ``diff_params=False`` stop-gradients the
+    weight stack (force/stress programs — same rationale as
+    :func:`fused_edge_aggregate`); training keeps the default True.
+    """
+    perm, inv, segments = packed_m_layout(m_idx)
+
+    def ref(h_, *ws):
+        return so2_conv_reference(h_[:, perm, :], list(ws), segments,
+                                  channels)[:, inv, :]
+
+    mode = resolve_kernel_mode(kernels)
+    use = mode != "xla" and h.shape[0] > 0
+    _count(use)
+    if not use:
+        return ref(h, *weights)
+    interpret = mode == "interpret"
+    if not diff_params:
+        weights = [jax.lax.stop_gradient(w) for w in weights]
+
+    @jax.custom_vjp
+    def f(h_, *ws):
+        return so2_conv_pallas(h_[:, perm, :], list(ws), segments, channels,
+                               interpret=interpret)[:, inv, :]
+
+    def f_fwd(h_, *ws):
+        return f(h_, *ws), (h_,) + ws
+
+    def f_bwd(res, g):
+        h_, ws = res[0], res[1:]
+        if diff_params:
+            _, vjp_fn = jax.vjp(ref, h_, *ws)
+            return vjp_fn(g)
+        _, vjp_fn = jax.vjp(lambda hh: ref(hh, *ws), h_)
+        (gh,) = vjp_fn(g)
+        return (gh,) + tuple(jnp.zeros(w.shape, w.dtype) for w in ws)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(h, *weights)

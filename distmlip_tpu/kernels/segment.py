@@ -1,0 +1,372 @@
+"""Dst-tiled Pallas segment kernels (fused gather -> edge compute -> scatter).
+
+The padding contract (ops/segment.py, partition/graph.py) keeps every edge
+array dst-sorted: ``segment_ids`` is globally nondecreasing within a layout
+segment, padded rows repeat the last real id, and a validity mask screens
+padding. That contract is exactly what makes a DESTINATION-TILED kernel
+possible: the edges landing in dst rows ``[t*TILE_N, (t+1)*TILE_N)`` form a
+CONTIGUOUS slice of the edge array whose bounds come from one on-device
+``searchsorted`` over the tile boundaries (:func:`dst_tile_offsets`).
+
+Each grid step then owns one dst tile: it streams that tile's edge slice
+from HBM in fixed-size blocks (async DMA into VMEM scratch), optionally
+gathers per-edge rows from VMEM-resident node arrays, applies a
+caller-supplied per-edge compute, and accumulates into the tile's
+``(TILE_N, W)`` VMEM accumulator with a one-hot MXU matmul — the classic
+TPU segment-sum idiom. The ``(E, width)`` message tensor never exists:
+messages live one ``(BLK, width)`` block at a time in VMEM.
+
+Everything here is the raw kernel layer: no routing, no autodiff. Call
+sites go through :mod:`distmlip_tpu.kernels.dispatch`, which adds the
+XLA fallback and the custom VJPs.
+
+Shapes are NOT required to be multiples of the tile sizes — inputs are
+guard-padded with ZERO-filled rows (:func:`_prepare_edges`) so in-kernel
+block slices never hit ``dynamic_slice``'s end-clamp, and outputs are
+sliced back. The guard rows' content is never read as real data: tile
+offsets come from the UNPADDED ids, and the in-kernel ``pos < tile_end``
+test screens every guard row before it can reach the accumulator — do
+not drop that test in favor of trusting the pad values.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# default tile of destination rows per grid step and edges per streamed
+# block. Both are compile-time constants of one pallas_call; the dispatch
+# layer may shrink them for tiny problems so guard padding stays bounded.
+TILE_N = 128
+EDGE_BLK = 256
+
+
+def dst_tile_offsets(segment_ids, num_segments: int, tile_n: int):
+    """(num_tiles + 1,) int32 edge offsets of each dst tile's slice.
+
+    ``segment_ids`` must be nondecreasing (the dst-sorted contract);
+    ``offsets[t]`` is the first edge whose dst lands at or past row
+    ``t * tile_n``, so tile ``t`` owns edges ``[offsets[t], offsets[t+1])``.
+    Runs on device inside the surrounding jit (one ``searchsorted`` over
+    ``num_tiles + 1`` boundaries — noise next to the aggregation itself).
+    """
+    num_tiles = -(-num_segments // tile_n)
+    bounds = jnp.arange(num_tiles + 1, dtype=segment_ids.dtype) * tile_n
+    return jnp.searchsorted(segment_ids, bounds, side="left").astype(jnp.int32)
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _pad_rows(x, rows: int, fill=0):
+    if rows == 0:
+        return x
+    widths = [(0, rows)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def _flatten_width(x):
+    """(E, ...) -> (E, W) with W >= 1 (scalars get a singleton lane)."""
+    if x.ndim == 1:
+        return x[:, None]
+    return x.reshape(x.shape[0], -1)
+
+
+def _pick_tiles(n_edges: int, num_segments: int, tile_n: int | None,
+                edge_blk: int | None):
+    """Clamp the static tile sizes to the problem so guard padding on tiny
+    graphs (tests, 1-atom structures) stays proportionate."""
+    tn = tile_n if tile_n else min(TILE_N, max(8, _round_up(num_segments, 8)))
+    eb = edge_blk if edge_blk else min(EDGE_BLK, max(8, _round_up(n_edges, 8)))
+    return int(tn), int(eb)
+
+
+def _prepare_edges(arrays, n_edges: int, edge_blk: int):
+    """Guard-pad every (E, ...) array to ``round_up(E, blk) + blk`` rows so
+    in-kernel block slices never hit ``dynamic_slice``'s end-clamp (which
+    would silently re-read earlier rows)."""
+    e_pad = _round_up(max(n_edges, 1), edge_blk) + edge_blk
+    return [_pad_rows(a, e_pad - n_edges) for a in arrays], e_pad
+
+
+def _block_copy(src_ref, dst_ref, sem, start, rows: int):
+    """DMA ``rows`` rows of ``src_ref`` starting at ``start`` into VMEM."""
+    cp = pltpu.make_async_copy(src_ref.at[pl.ds(start, rows)], dst_ref, sem)
+    cp.start()
+    cp.wait()
+
+
+def _onehot_accumulate(acc, msg, local_dst, valid, tile_n: int):
+    """acc += onehot(local_dst)^T @ (msg * valid): the per-block dst
+    scatter as ONE MXU matmul against a (BLK, TILE_N) one-hot."""
+    blk = msg.shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (blk, tile_n), 1)
+    onehot = jnp.where((local_dst[:, None] == cols) & valid[:, None], 1.0, 0.0
+                       ).astype(jnp.float32)
+    return acc + jax.lax.dot_general(
+        onehot, msg.astype(jnp.float32),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _gather_rows(node_ref, idx, width: int):
+    """(BLK,) indexed rows of a VMEM-resident (N, W) node ref.
+
+    Row-looped dynamic slices — the node array is VMEM-resident (the
+    dispatch layer only routes arrays under its VMEM budget here; larger
+    arrays are pre-gathered by XLA), so each read is an on-chip dynamic
+    slice, not an HBM round trip.
+    """
+    blk = idx.shape[0]
+
+    zero = jnp.zeros((), dtype=idx.dtype)  # match idx dtype under x64 tracing
+
+    def body(j, acc):
+        row = jax.lax.dynamic_slice(node_ref[:], (idx[j], zero), (1, width))
+        return jax.lax.dynamic_update_slice(acc, row,
+                                            (j.astype(idx.dtype), zero))
+
+    init = jnp.zeros((blk, width), dtype=node_ref.dtype)
+    return jax.lax.fori_loop(0, blk, body, init)
+
+
+# ---------------------------------------------------------------------------
+# fused segment sum (data already per-edge)
+# ---------------------------------------------------------------------------
+
+def pallas_segment_sum(data, segment_ids, num_segments: int, mask=None, *,
+                       tile_n: int | None = None, edge_blk: int | None = None,
+                       interpret: bool = False):
+    """Masked dst-tiled segment sum of dst-sorted ``data``.
+
+    Drop-in for ``ops.segment.masked_segment_sum(..., indices_are_sorted=
+    True)`` on sorted layouts: same masking semantics (padded rows repeat
+    the last real id and are screened by ``mask``), fp32 accumulation in
+    VMEM, result cast back to ``data.dtype``. ``data`` may carry any
+    trailing shape; it is streamed as ``(E, prod(trailing))``.
+    """
+    e = data.shape[0]
+    out_shape = (num_segments,) + data.shape[1:]
+    if e == 0 or num_segments == 0:
+        return jnp.zeros(out_shape, dtype=data.dtype)
+    flat = _flatten_width(data)
+    w = flat.shape[1]
+    tn, eb = _pick_tiles(e, num_segments, tile_n, edge_blk)
+    ntile = -(-num_segments // tn)
+    offs = dst_tile_offsets(segment_ids, num_segments, tn)
+
+    m = (jnp.ones((e,), jnp.int32) if mask is None
+         else mask.astype(jnp.int32))
+    (flat_p, ids_p, m_p), _ = _prepare_edges(
+        [flat, segment_ids.astype(jnp.int32), m], e, eb)
+
+    kernel = functools.partial(_segment_sum_kernel, tile_n=tn, edge_blk=eb,
+                               width=w)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ntile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),   # ids
+            pl.BlockSpec(memory_space=pltpu.ANY),   # mask
+            pl.BlockSpec(memory_space=pltpu.ANY),   # data
+        ],
+        out_specs=pl.BlockSpec((tn, w), lambda i, offs: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((eb,), jnp.int32),
+            pltpu.VMEM((eb,), jnp.int32),
+            pltpu.VMEM((eb, w), flat.dtype),
+            pltpu.VMEM((tn, w), jnp.float32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((ntile * tn, w), data.dtype),
+        interpret=interpret,
+    )(offs, ids_p, m_p, flat_p)
+    return out[:num_segments].reshape(out_shape)
+
+
+def _segment_sum_kernel(offs_ref, ids_ref, mask_ref, data_ref, out_ref,
+                        ids_s, mask_s, data_s, acc_s, sems, *,
+                        tile_n: int, edge_blk: int, width: int):
+    i = pl.program_id(0)
+    e0 = offs_ref[i]
+    e1 = offs_ref[i + 1]
+    acc_s[:] = jnp.zeros_like(acc_s)
+    nblk = pl.cdiv(e1 - e0, edge_blk)
+
+    def body(b, _):
+        s = e0 + b * edge_blk
+        _block_copy(ids_ref, ids_s, sems.at[0], s, edge_blk)
+        _block_copy(mask_ref, mask_s, sems.at[1], s, edge_blk)
+        _block_copy(data_ref, data_s, sems.at[2], s, edge_blk)
+        pos = s + jax.lax.broadcasted_iota(jnp.int32, (edge_blk, 1), 0)[:, 0]
+        valid = (pos < e1) & (mask_s[:] != 0)
+        local = ids_s[:] - i * tile_n
+        acc_s[:] = _onehot_accumulate(acc_s[:], data_s[:], local, valid,
+                                      tile_n)
+        return _
+
+    jax.lax.fori_loop(0, nblk, body, None)
+    out_ref[:] = acc_s[:].astype(out_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# fused gather -> edge compute -> scatter
+# ---------------------------------------------------------------------------
+
+def pallas_edge_aggregate(edge_fn, inputs, segment_ids, num_segments: int,
+                          mask=None, *, out_shape, out_dtype, consts=(),
+                          tile_n: int | None = None,
+                          edge_blk: int | None = None,
+                          interpret: bool = False):
+    """Fused gather + per-edge compute + dst-tiled scatter.
+
+    ``inputs`` is a sequence of either per-edge arrays ``(E, ...)``
+    (streamed from HBM block by block) or ``("gather", node_array, idx)``
+    triples — ``node_array`` rides VMEM whole and its ``idx`` rows are
+    gathered INSIDE the kernel per block. ``edge_fn(*blocks)`` receives one
+    ``(BLK, ...)`` block per input (original trailing shapes restored) and
+    returns ``(BLK,) + out_shape`` messages, which are masked and
+    accumulated onto their dst rows without ever materializing the
+    ``(E,) + out_shape`` message tensor. ``consts`` are whole-array
+    kernel inputs (edge-MLP weights, coupling tables — hoisted closure
+    captures, a Pallas kernel cannot close over arrays) appended to the
+    ``edge_fn`` call after the per-edge blocks; they ride VMEM whole.
+
+    The caller guarantees ``segment_ids`` is nondecreasing (the dst-sorted
+    layout contract) — exactly the precondition of the
+    ``indices_are_sorted=True`` fast path this kernel replaces.
+    """
+    e = segment_ids.shape[0]
+    full_out = (num_segments,) + tuple(out_shape)
+    if e == 0 or num_segments == 0:
+        return jnp.zeros(full_out, dtype=out_dtype)
+    tn, eb = _pick_tiles(e, num_segments, tile_n, edge_blk)
+    ntile = -(-num_segments // tn)
+    offs = dst_tile_offsets(segment_ids, num_segments, tn)
+    w_out = 1
+    for d in out_shape:
+        w_out *= int(d)
+
+    # split inputs into streamed per-edge arrays and gathered node arrays;
+    # every input contributes exactly ONE streamed array (its data, or the
+    # gather's idx column), so input position == streamed-array position
+    edge_arrays = []                    # flattened (E, Wi), one per input
+    node_arrays, node_widths = [], []
+    kinds = []                          # ("edge", trailing)|("gather", k, tr)
+    for item in inputs:
+        if isinstance(item, tuple) and len(item) == 3 and item[0] == "gather":
+            _, node, idx = item
+            node2 = _flatten_width(node)
+            kinds.append(("gather", len(node_arrays), node.shape[1:]))
+            node_arrays.append(node2)
+            node_widths.append(node2.shape[1])
+            edge_arrays.append(idx.astype(jnp.int32)[:, None])
+        else:
+            arr = jnp.asarray(item)
+            kinds.append(("edge", None, arr.shape[1:]))
+            edge_arrays.append(_flatten_width(arr))
+
+    m = (jnp.ones((e,), jnp.int32) if mask is None
+         else mask.astype(jnp.int32))
+    padded, _ = _prepare_edges(
+        [segment_ids.astype(jnp.int32), m] + edge_arrays, e, eb)
+    ids_p, m_p = padded[0], padded[1]
+    edge_p = padded[2:]
+
+    # whole-array consts: 0/1-d arrays ride as (1, n) (TPU wants >= 2-d
+    # tiles); the kernel restores the original shapes before edge_fn
+    const_shapes = tuple(jnp.shape(c) for c in consts)
+    const_in = [jnp.asarray(c).reshape(
+        (1, max(1, int(jnp.size(c)))) if jnp.ndim(c) < 2 else jnp.shape(c))
+        for c in consts]
+
+    kernel = functools.partial(
+        _edge_aggregate_kernel, edge_fn=edge_fn, kinds=kinds,
+        node_widths=node_widths, const_shapes=const_shapes, tile_n=tn,
+        edge_blk=eb, w_out=w_out, out_shape=tuple(out_shape))
+    n_stream = 2 + len(edge_p)  # ids + mask + per-edge arrays
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ntile,),
+        in_specs=(
+            [pl.BlockSpec(memory_space=pltpu.ANY)] * n_stream
+            + [pl.BlockSpec(memory_space=pltpu.VMEM)]
+            * (len(node_arrays) + len(const_in))
+        ),
+        out_specs=pl.BlockSpec((tn, w_out), lambda i, offs: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((eb,), jnp.int32),
+            pltpu.VMEM((eb,), jnp.int32),
+        ] + [
+            pltpu.VMEM((eb, a.shape[1]), a.dtype) for a in edge_p
+        ] + [
+            pltpu.VMEM((tn, w_out), jnp.float32),
+            pltpu.SemaphoreType.DMA((n_stream,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((ntile * tn, w_out), out_dtype),
+        interpret=interpret,
+    )(offs, ids_p, m_p, *edge_p, *node_arrays, *const_in)
+    return out[:num_segments].reshape(full_out)
+
+
+def _edge_aggregate_kernel(offs_ref, ids_ref, mask_ref, *refs, edge_fn,
+                           kinds, node_widths, const_shapes, tile_n: int,
+                           edge_blk: int, w_out: int, out_shape):
+    n_edge = len(kinds)
+    n_node = len(node_widths)
+    n_const = len(const_shapes)
+    edge_refs = refs[:n_edge]
+    node_refs = refs[n_edge:n_edge + n_node]
+    const_refs = refs[n_edge + n_node:n_edge + n_node + n_const]
+    out_ref = refs[n_edge + n_node + n_const]
+    ids_s = refs[n_edge + n_node + n_const + 1]
+    mask_s = refs[n_edge + n_node + n_const + 2]
+    edge_s = refs[n_edge + n_node + n_const + 3:
+                  n_edge + n_node + n_const + 3 + n_edge]
+    acc_s = refs[-2]
+    sems = refs[-1]
+    const_vals = [r[:].reshape(shp) for r, shp in
+                  zip(const_refs, const_shapes)]
+
+    i = pl.program_id(0)
+    e0 = offs_ref[i]
+    e1 = offs_ref[i + 1]
+    acc_s[:] = jnp.zeros_like(acc_s)
+    nblk = pl.cdiv(e1 - e0, edge_blk)
+
+    def body(b, _):
+        s = e0 + b * edge_blk
+        _block_copy(ids_ref, ids_s, sems.at[0], s, edge_blk)
+        _block_copy(mask_ref, mask_s, sems.at[1], s, edge_blk)
+        for k, (eref, sref) in enumerate(zip(edge_refs, edge_s)):
+            _block_copy(eref, sref, sems.at[2 + k], s, edge_blk)
+        args = []
+        for p, (tag, node_k, trailing) in enumerate(kinds):
+            if tag == "gather":
+                idx = edge_s[p][:][:, 0]
+                rows = _gather_rows(node_refs[node_k], idx,
+                                    node_widths[node_k])
+                args.append(rows.reshape((edge_blk,) + tuple(trailing)))
+            else:
+                args.append(edge_s[p][:].reshape(
+                    (edge_blk,) + tuple(trailing)))
+        msg = edge_fn(*args, *const_vals).reshape(edge_blk, w_out)
+        pos = s + jax.lax.broadcasted_iota(jnp.int32, (edge_blk, 1), 0)[:, 0]
+        valid = (pos < e1) & (mask_s[:] != 0)
+        local = ids_s[:] - i * tile_n
+        acc_s[:] = _onehot_accumulate(acc_s[:], msg, local, valid, tile_n)
+        return _
+
+    jax.lax.fori_loop(0, nblk, body, None)
+    out_ref[:] = acc_s[:].astype(out_ref.dtype)

@@ -49,10 +49,10 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from ..kernels.dispatch import Gather, fused_edge_aggregate
 from ..ops import radial
 from ..ops.nn import (cast_params_subtrees, embedding, gated_mlp,
                       gated_mlp_init, linear, linear_init, mlp, mlp_init)
-from ..ops.segment import masked_segment_sum
 
 
 @dataclass(frozen=True)
@@ -346,13 +346,23 @@ class CHGNet:
         v_center] summed to the dst bond, out linear, per-bond rbf weights
         applied post-aggregation, residual. Only locally-computed bond nodes
         receive in-lines (the partitioner's needs_in_line rule); halo bonds
-        are refreshed by the surrounding exchanges."""
-        feats = jnp.concatenate(
-            [b[lg.line_src], b[lg.line_dst], a, v[lg.line_center]], axis=-1
-        )
-        m = gated_mlp(blk["node_update"], feats)
-        agg = masked_segment_sum(m, lg.line_dst, lg.b_cap, line_ok,
-                                 indices_are_sorted=True)
+        are refreshed by the surrounding exchanges.
+
+        The line-graph message (gathers + gated MLP + dst-sorted sum) goes
+        through the kernel dispatcher: on the Pallas path it fuses per dst
+        tile and the (L, 4C) concat / (L, C) message intermediates never
+        materialize; the XLA path is the historical program."""
+
+        def line_msg(b_src, b_dst, a_row, v_ctr):
+            return gated_mlp(blk["node_update"], jnp.concatenate(
+                [b_src, b_dst, a_row, v_ctr], axis=-1))
+
+        agg = fused_edge_aggregate(
+            line_msg,
+            [Gather(b, lg.line_src), Gather(b, lg.line_dst), a,
+             Gather(v, lg.line_center)],
+            lg.line_dst, lg.b_cap, line_ok, indices_are_sorted=True,
+            kernels=lg.kernels, diff_params=lg.kernels_diff_params)
         upd = linear(blk["node_out"], agg)
         if tbw is not None:
             upd = upd * tbw

@@ -46,9 +46,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.dispatch import fused_segment_sum, fused_so2_conv
 from ..ops import radial
 from ..ops.nn import cast_params_subtrees, linear, linear_init, mlp, mlp_init
-from ..ops.segment import masked_segment_sum
 from ..ops.so3_e3nn import CoeffLayout, wigner_blocks_from_edges
 
 
@@ -251,9 +251,11 @@ class ESCN:
                 msg = per_chunk(srcc, dstc, maskc, D, besc, envc)
                 return (
                     acc
-                    + masked_segment_sum(
-                        # sorted within every chunk by chunk_layout
+                    + fused_segment_sum(
+                        # sorted within every chunk by chunk_layout;
+                        # Pallas dst-tiled scatter on TPU (kernels/dispatch)
                         msg, dstc, lg.n_cap, maskc, indices_are_sorted=True,
+                        kernels=lg.kernels,
                     ),
                     None,
                 )
@@ -374,8 +376,21 @@ class ESCN:
 
         inv_avg = jnp.asarray(1.0 / cfg.avg_num_neighbors, dtype=dtype)
         for layer in params["layers"]:
+            if not batched_gate:
+                # globally consistent gate: mix experts ONCE in weight
+                # space per layer (K small GEMMs) — the fused SO(2) kernel
+                # then runs every per-|m| GEMM in one VMEM-resident
+                # pallas_call (kernels/so3; XLA fallback is the same math)
+                mixw = lambda Wk: jnp.einsum("k,kab->ab", mole, Wk)
+                ws_mixed = [mixw(layer["so2"]["m0"])]
+                for m in range(1, cfg.l_max + 1):
+                    ws_mixed.append(mixw(layer["so2"][f"m{m}r"]))
+                    ws_mixed.append(mixw(layer["so2"][f"m{m}i"]))
+            else:
+                ws_mixed = None
 
-            def so2_chunk(srcc, dstc, maskc, D, besc, envc, layer=layer):
+            def so2_chunk(srcc, dstc, maskc, D, besc, envc, layer=layer,
+                          ws_mixed=ws_mixed):
                 # edge conditioning scalars
                 ef = jnp.concatenate(
                     [besc, zemb[srcc], zemb[dstc]], axis=-1
@@ -389,9 +404,18 @@ class ESCN:
                 # per-edge structure gate (dst rows are always real atoms)
                 mole_e = mole[lg.struct_id[dstc]] if batched_gate else None
 
-                # SO(2) convolutions per |m|; the per-m feature vector
-                # flattens (nl, C) row-major — the (d, d) weight basis
-                # follows this order
+                if not batched_gate:
+                    # fused path: all per-|m| complex-pair GEMMs in one
+                    # kernel on the pre-mixed weights
+                    return rotate(
+                        fused_so2_conv(h_rot, ws_mixed, self.m_idx,
+                                       C, kernels=lg.kernels,
+                                       diff_params=lg.kernels_diff_params),
+                        D) * envc[:, None, None]
+
+                # batched (per-edge expert) gate: the weight mixture is
+                # per edge, so the kernel's one-weight-per-m contract does
+                # not apply — keep the XLA per-|m| loop
                 y = jnp.zeros_like(h_rot)
                 for m in range(cfg.l_max + 1):
                     plus, minus = self.m_idx[m]

@@ -42,7 +42,7 @@ import numpy as np
 
 from ..ops import radial
 from ..ops.nn import cast_params_subtrees
-from ..ops.segment import masked_segment_sum
+from ..kernels.dispatch import fused_segment_sum
 from ..ops.so3_e3nn import CoeffLayout, wigner_blocks_from_edges
 
 
@@ -432,10 +432,11 @@ class ESCNMD:
                 D = wigner_blocks(rhatc)
                 msg = per_chunk(srcc, dstc, maskc, D, gaussc, envc)
                 return (
-                    acc + masked_segment_sum(
-                        # sorted within every chunk by chunk_layout
+                    acc + fused_segment_sum(
+                        # sorted within every chunk by chunk_layout;
+                        # Pallas dst-tiled scatter on TPU (kernels/dispatch)
                         msg, dstc, lg.n_cap, maskc,
-                        indices_are_sorted=True),
+                        indices_are_sorted=True, kernels=lg.kernels),
                     None,
                 )
 

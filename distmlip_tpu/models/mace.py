@@ -42,9 +42,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.dispatch import fused_segment_sum
 from ..ops import radial
 from ..ops.nn import linear, linear_init, linear_init_vp, mlp, mlp_init, mlp_init_vp
-from ..ops.segment import masked_segment_sum
 from ..ops.so3 import (
     real_clebsch_gordan,
     spherical_harmonics,
@@ -479,9 +479,12 @@ class MACE:
             M = M * Rc[:, q_path, :]                      # per-path radial
             return (
                 A_acc
-                + masked_segment_sum(
-                    # sorted within every chunk by chunk_layout construction
+                + fused_segment_sum(
+                    # sorted within every chunk by chunk_layout
+                    # construction; dispatches to the dst-tiled Pallas
+                    # scatter kernel on TPU (kernels/dispatch)
                     M, dstc, n_nodes, maskc, indices_are_sorted=True,
+                    kernels=lg.kernels,
                 ),
                 None,
             )

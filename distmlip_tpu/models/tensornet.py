@@ -29,6 +29,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from ..kernels.dispatch import Gather
 from ..ops import radial
 from ..ops.nn import (cast_params_subtrees, embedding, layernorm,
                       layernorm_init, linear, linear_init, mlp, mlp_init)
@@ -157,12 +158,21 @@ class TensorNet:
         W1 = linear(params["dist_proj"][0], rbf) * env[:, None]  # (E, C)
         W2 = linear(params["dist_proj"][1], rbf) * env[:, None]
         W3 = linear(params["dist_proj"][2], rbf) * env[:, None]
-        edge_X = Zij[:, None, None, :] * (
-            W1[:, None, None, :] * eye
-            + W2[:, None, None, :] * A_e
-            + W3[:, None, None, :] * S_e
-        )                                                        # (E, 3, 3, C)
-        X = lg.aggregate_edges(edge_X, lg.edge_mask)
+
+        # the (E, 3, 3, C) edge tensor is 9C wide vs the ~4C of its inputs
+        # — built INSIDE the fused dst-tile kernel on the Pallas path, so
+        # it never materializes in HBM (kernels/dispatch); the XLA path
+        # builds it whole and segment-sums with the sorted hint, exactly
+        # the historical program
+        def embed_msg(zij, w1, w2, w3, ae, se):
+            return zij[:, None, None, :] * (
+                w1[:, None, None, :] * eye
+                + w2[:, None, None, :] * ae
+                + w3[:, None, None, :] * se
+            )
+
+        X = lg.aggregate_edge_messages(
+            embed_msg, (Zij, W1, W2, W3, A_e, S_e), mask=lg.edge_mask)
 
         norm = layernorm(params["init_norm"], tensor_norm(X))
         for lin in params["emb_lin_scalar"]:
@@ -208,10 +218,18 @@ class TensorNet:
         S = _mix(lp["lin_tensor"][2], S)
         Y = I + A + S
 
-        msg = (f[:, None, None, :, 0] * I[lg.edge_src]
-               + f[:, None, None, :, 1] * A[lg.edge_src]
-               + f[:, None, None, :, 2] * S[lg.edge_src])
-        M = lg.aggregate_edges(msg, lg.edge_mask)
+        # 27C of gathered src components fold into a 9C message inside the
+        # fused kernel (in-kernel src gather on the Pallas path)
+        def int_msg(f_e, i_s, a_s, s_s):
+            return (f_e[:, None, None, :, 0] * i_s
+                    + f_e[:, None, None, :, 1] * a_s
+                    + f_e[:, None, None, :, 2] * s_s)
+
+        M = lg.aggregate_edge_messages(
+            int_msg,
+            (f, Gather(I, lg.edge_src), Gather(A, lg.edge_src),
+             Gather(S, lg.edge_src)),
+            mask=lg.edge_mask)
 
         # batched 3x3 matmuls over (node, channel); the matrix axes are
         # (-3, -2), channels ride the lane axis untouched

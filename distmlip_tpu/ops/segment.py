@@ -30,22 +30,34 @@ def masked_segment_sum(data, segment_ids, num_segments: int, mask=None,
                                indices_are_sorted=indices_are_sorted)
 
 
-def masked_segment_mean(data, segment_ids, num_segments: int, mask=None, eps=1e-12):
-    tot = masked_segment_sum(data, segment_ids, num_segments, mask)
+def masked_segment_mean(data, segment_ids, num_segments: int, mask=None,
+                        eps=1e-12, indices_are_sorted: bool = False):
+    tot = masked_segment_sum(data, segment_ids, num_segments, mask,
+                             indices_are_sorted=indices_are_sorted)
     ones = jnp.ones(data.shape[0], dtype=data.dtype)
-    cnt = masked_segment_sum(ones, segment_ids, num_segments, mask)
+    cnt = masked_segment_sum(ones, segment_ids, num_segments, mask,
+                             indices_are_sorted=indices_are_sorted)
     return tot / jnp.maximum(cnt, eps).reshape(cnt.shape + (1,) * (tot.ndim - cnt.ndim))
 
 
-def masked_segment_softmax(logits, segment_ids, num_segments: int, mask=None):
-    """Numerically stable segment softmax over masked edges."""
+def masked_segment_softmax(logits, segment_ids, num_segments: int, mask=None,
+                           indices_are_sorted: bool = False):
+    """Numerically stable segment softmax over masked edges.
+
+    ``indices_are_sorted`` plumbs through to the inner ``segment_max`` /
+    ``segment_sum`` — dst-sorted edge arrays keep the TPU scatter fast
+    path through softmax aggregation too, not just plain sums.
+    """
     neg = jnp.finfo(logits.dtype).min
     if mask is not None:
         logits = jnp.where(mask, logits, neg)
-    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments=num_segments)
+    seg_max = jax.ops.segment_max(logits, segment_ids,
+                                  num_segments=num_segments,
+                                  indices_are_sorted=indices_are_sorted)
     logits = logits - seg_max[segment_ids]
     ex = jnp.exp(logits)
     if mask is not None:
         ex = jnp.where(mask, ex, 0.0)
-    denom = jax.ops.segment_sum(ex, segment_ids, num_segments=num_segments)
+    denom = jax.ops.segment_sum(ex, segment_ids, num_segments=num_segments,
+                                indices_are_sorted=indices_are_sorted)
     return ex / jnp.maximum(denom[segment_ids], 1e-30)

@@ -38,7 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.segment import masked_segment_sum
+from ..kernels.dispatch import (Gather, fused_edge_aggregate,
+                                fused_segment_sum)
 from ..telemetry import scope
 
 HALO_MODES = ("coalesced", "legacy")
@@ -185,6 +186,17 @@ class LocalGraph:
     # LocalGraph so the runtime sees them inside the traced function.
     batch_size: int = 0
     struct_id: Any = None
+    # Pallas kernel routing for the aggregation helpers below and the
+    # models' own dispatch calls: None = env/backend default, False =
+    # force the pure-XLA path, "interpret" = interpreter-mode kernels
+    # (kernels/dispatch.resolve_kernel_mode)
+    kernels: Any = None
+    # whether fused-kernel custom VJPs propagate gradients into model
+    # parameters (edge-MLP weights, SO(2) stacks). Training programs need
+    # True; force/stress programs pass False so the kernel path emits no
+    # weight-cotangent work or replicated-input psums (see
+    # kernels/dispatch.fused_edge_aggregate)
+    kernels_diff_params: bool = True
 
     @property
     def has_frontier_split(self) -> bool:
@@ -276,17 +288,52 @@ class LocalGraph:
         the concatenation is NOT — so the sorted fast path runs per
         segment. This is the drop-in replacement for the historical
         full-array ``masked_segment_sum(..., indices_are_sorted=True)``.
+        Routes through the kernel dispatcher: on the Pallas path the
+        masked scatter runs as the dst-tiled fused kernel.
         """
         if not self.has_frontier_split:
-            return masked_segment_sum(data, self.edge_dst, self.n_cap, mask,
-                                      indices_are_sorted=True)
+            return fused_segment_sum(data, self.edge_dst, self.n_cap, mask,
+                                     indices_are_sorted=True,
+                                     kernels=self.kernels)
         s = self.e_split
-        out = masked_segment_sum(
+        out = fused_segment_sum(
             data[:s], self.edge_dst[:s], self.n_cap,
-            None if mask is None else mask[:s], indices_are_sorted=True)
-        return out + masked_segment_sum(
+            None if mask is None else mask[:s], indices_are_sorted=True,
+            kernels=self.kernels)
+        return out + fused_segment_sum(
             data[s:], self.edge_dst[s:], self.n_cap,
-            None if mask is None else mask[s:], indices_are_sorted=True)
+            None if mask is None else mask[s:], indices_are_sorted=True,
+            kernels=self.kernels)
+
+    def aggregate_edge_messages(self, msg_fn, edge_inputs, mask=None):
+        """Fused per-edge compute + dst aggregation ((n_cap, ...)).
+
+        ``msg_fn(*rows) -> (E, ...)`` messages from per-edge inputs;
+        ``edge_inputs`` may mix per-edge arrays with
+        :class:`distmlip_tpu.kernels.Gather` markers (node-array rows
+        gathered at per-edge indices). Honors the interior/frontier
+        layout like :meth:`aggregate_edges`. On the Pallas path the
+        gather, the message compute and the dst scatter fuse per dst
+        tile and the ``(E, width)`` message tensor never materializes;
+        the XLA path computes ``msg_fn`` on the full edge arrays and
+        segment-sums with the sorted hint (the historical program).
+        """
+        if not self.has_frontier_split:
+            return fused_edge_aggregate(
+                msg_fn, edge_inputs, self.edge_dst, self.n_cap, mask,
+                indices_are_sorted=True, kernels=self.kernels,
+                diff_params=self.kernels_diff_params)
+        out = None
+        for sl in (slice(0, self.e_split), slice(self.e_split, None)):
+            sliced = [Gather(i.node, i.idx[sl]) if isinstance(i, Gather)
+                      else i[sl] for i in edge_inputs]
+            part = fused_edge_aggregate(
+                msg_fn, sliced, self.edge_dst[sl], self.n_cap,
+                None if mask is None else mask[sl],
+                indices_are_sorted=True, kernels=self.kernels,
+                diff_params=self.kernels_diff_params)
+            out = part if out is None else out + part
+        return out
 
     def chunk_sorted(self, chunk: int) -> bool:
         """Whether every ``chunk``-row slice of ``edge_dst`` is
@@ -316,10 +363,13 @@ class LocalGraph:
         """
         with scope("overlapped_edge_sum"):
             if not self.has_frontier_split:
-                msg = msg_fn(v_post[self.edge_src], v_post[self.edge_dst],
-                             *edge_data)
-                return masked_segment_sum(msg, self.edge_dst, self.n_cap,
-                                          mask, indices_are_sorted=True)
+                return fused_edge_aggregate(
+                    msg_fn,
+                    [Gather(v_post, self.edge_src),
+                     Gather(v_post, self.edge_dst), *edge_data],
+                    self.edge_dst, self.n_cap, mask,
+                    indices_are_sorted=True, kernels=self.kernels,
+                    diff_params=self.kernels_diff_params)
             s = self.e_split
             out = None
             for name, sl, v in (("interior", slice(0, s), v_pre),
@@ -328,13 +378,15 @@ class LocalGraph:
                     # dst rows are always owned: read them from v_pre in
                     # BOTH segments so only the frontier src gather waits
                     # on the collective
-                    msg = msg_fn(v[self.edge_src[sl]],
-                                 v_pre[self.edge_dst[sl]],
-                                 *[d[sl] for d in edge_data])
-                    part = masked_segment_sum(
-                        msg, self.edge_dst[sl], self.n_cap,
+                    part = fused_edge_aggregate(
+                        msg_fn,
+                        [Gather(v, self.edge_src[sl]),
+                         Gather(v_pre, self.edge_dst[sl]),
+                         *[d[sl] for d in edge_data]],
+                        self.edge_dst[sl], self.n_cap,
                         None if mask is None else mask[sl],
-                        indices_are_sorted=True)
+                        indices_are_sorted=True, kernels=self.kernels,
+                        diff_params=self.kernels_diff_params)
                 out = part if out is None else out + part
             return out
 
@@ -406,13 +458,19 @@ class LocalGraph:
 
 
 def local_graph_from_stacked(
-    g, axis_name: str | None, halo_mode: str = "coalesced",
+    g, axis_name: str | None, halo_mode: str = "coalesced", kernels=None,
+    kernels_diff_params: bool = True,
 ) -> tuple[LocalGraph, Any]:
     """Build a LocalGraph from shard-local (1, ...) slices of a PartitionedGraph.
 
     Returns (local_graph, positions_local) where positions keep their leading
     1-axis squeezed. ``halo_mode`` selects the exchange implementation
-    (``"coalesced"`` | ``"legacy"``, see module docstring).
+    (``"coalesced"`` | ``"legacy"``, see module docstring); ``kernels``
+    is the Pallas-kernel routing flag the aggregation helpers dispatch on
+    (None = env/backend default, False = pure XLA, "interpret" = the
+    chip-free interpreter kernels); ``kernels_diff_params`` is whether
+    kernel custom VJPs propagate into model weights (training True,
+    force/stress programs False).
     """
     validate_halo_mode(halo_mode)
     sq = lambda a: a[0] if a is not None and hasattr(a, "shape") and a.ndim >= 1 else a
@@ -424,6 +482,8 @@ def local_graph_from_stacked(
         b_cap=g.b_cap,
         e_split=g.e_split,
         halo_mode=halo_mode,
+        kernels=kernels,
+        kernels_diff_params=kernels_diff_params,
         species=sq(g.species),
         node_mask=sq(g.node_mask),
         owned_mask=sq(g.owned_mask),

@@ -112,9 +112,16 @@ def graph_shardings(mesh: Mesh, graph: PartitionedGraph):
 
 
 def make_total_energy(model_energy_fn, mesh: Mesh | None,
-                      halo_mode: str = "coalesced", aux: bool = False):
+                      halo_mode: str = "coalesced", aux: bool = False,
+                      kernels=None, kernels_diff_params: bool = True):
     """Sharded total-energy fn: (params, graph, positions, strain) -> scalar
     (or (scalar, aux_pytree) with ``aux=True``).
+
+    ``kernels_diff_params`` defaults True (training-safe: loss grads flow
+    into model weights through the fused-kernel custom VJPs); the
+    force/stress factories below pass False — they differentiate
+    positions/strain only, and False keeps the kernel path free of
+    weight-cotangent compute and mesh psums (kernels/dispatch).
 
     ``positions`` is (P, N_cap, 3); only owned rows are read — halo rows are
     refreshed in-jit by the halo exchange so that gradients flow back to the
@@ -129,7 +136,17 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None,
 
     def local_energy(params, strain, graph_local, positions):
         axis = GRAPH_AXIS if mesh is not None else None
-        lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode)
+        if not kernels_diff_params:
+            # force/stress program: no param grads are ever requested, but
+            # the fused kernels' custom VJPs mark every primal perturbed —
+            # any param-bound cotangent they emit (embedding tables, node
+            # features of the first layer) would cross the shard_map
+            # boundary as a replicated-input psum that plain XLA AD never
+            # ships. Cut ALL of them here, inside the shard-local fn.
+            params = jax.lax.stop_gradient(params)
+        lg, _ = local_graph_from_stacked(
+            graph_local, axis, halo_mode, kernels=kernels,
+            kernels_diff_params=kernels_diff_params)
         dtype = positions.dtype
         with scope("apply_strain"):
             pos, lg.lattice = apply_strain(
@@ -170,7 +187,7 @@ def make_total_energy(model_energy_fn, mesh: Mesh | None,
 
 
 def make_site_fn(model_site_fn, mesh: Mesh | None,
-                 halo_mode: str = "coalesced"):
+                 halo_mode: str = "coalesced", kernels=None):
     """Jitted sharded per-atom readout: (params, graph, positions) ->
     (P, N_cap) site values (e.g. CHGNet magmoms — reference
     PESCalculator_Dist's compute_magmom surface, implementations/matgl/
@@ -191,7 +208,10 @@ def make_site_fn(model_site_fn, mesh: Mesh | None,
 
     def local_site(params, graph_local, positions):
         axis = GRAPH_AXIS if mesh is not None else None
-        lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode)
+        # forward-only readout: no grads at all, so no param cotangents
+        lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode,
+                                         kernels=kernels,
+                                         kernels_diff_params=False)
         pos = lg.halo_exchange(positions[0])
         with scope("model_site"):
             return model_site_fn(params, lg, pos)[None]
@@ -223,7 +243,8 @@ def make_site_fn(model_site_fn, mesh: Mesh | None,
 
 def make_potential_fn(model_energy_fn, mesh: Mesh | None,
                       compute_stress: bool = True,
-                      halo_mode: str = "coalesced", aux: bool = False):
+                      halo_mode: str = "coalesced", aux: bool = False,
+                      kernels=None):
     """Jitted (params, graph, positions) -> dict(energy, forces, stress).
 
     forces: (P, N_cap, 3) — per-partition owned rows (reassemble with
@@ -233,7 +254,9 @@ def make_potential_fn(model_energy_fn, mesh: Mesh | None,
     (P, N_cap, ...) per-atom outputs computed on the SAME forward pass.
     """
     total_energy = make_total_energy(model_energy_fn, mesh,
-                                     halo_mode=halo_mode, aux=aux)
+                                     halo_mode=halo_mode, aux=aux,
+                                     kernels=kernels,
+                                     kernels_diff_params=False)
 
     @jax.jit
     def potential(params, graph, positions):
@@ -264,7 +287,8 @@ def make_potential_fn(model_energy_fn, mesh: Mesh | None,
     return potential
 
 
-def _local_batched_energy(model_energy_fn, aux, halo_mode="coalesced"):
+def _local_batched_energy(model_energy_fn, aux, halo_mode="coalesced",
+                          kernels=None):
     """Shard-local batched energy: strain -> halo exchange -> model ->
     per-structure readout. Shared by the single-device packed path and the
     2-D mesh path (where it runs inside shard_map with the spatial axis
@@ -275,7 +299,13 @@ def _local_batched_energy(model_energy_fn, aux, halo_mode="coalesced"):
         # the meshless path); strain: (B_local, 3, 3) — this batch shard's
         # slots only
         axis = SPATIAL_AXIS if graph_local.spatial_size > 1 else None
-        lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode)
+        # batched inference engine: grads are positions/strain only — cut
+        # param-bound kernel-VJP cotangents before the mesh boundary (see
+        # make_total_energy)
+        params = jax.lax.stop_gradient(params)
+        lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode,
+                                         kernels=kernels,
+                                         kernels_diff_params=False)
         B = graph_local.batch_size
         dtype = positions.dtype
         pos = positions[0]
@@ -309,7 +339,8 @@ def _local_batched_energy(model_energy_fn, aux, halo_mode="coalesced"):
 
 
 def make_batched_potential_fn(model_energy_fn, compute_stress: bool = True,
-                              aux: bool = False, mesh: Mesh | None = None):
+                              aux: bool = False, mesh: Mesh | None = None,
+                              kernels=None):
     """Jitted batched potential over a block-diagonally packed graph.
 
     ``(params, graph, positions) -> dict`` where ``graph`` is a
@@ -347,7 +378,8 @@ def make_batched_potential_fn(model_energy_fn, compute_stress: bool = True,
     One executable family covers pure batch-parallel (B x 1), the 1-D ring
     (1 x S) and the mixed B x S placement.
     """
-    local_energy = _local_batched_energy(model_energy_fn, aux)
+    local_energy = _local_batched_energy(model_energy_fn, aux,
+                                         kernels=kernels)
 
     if mesh is None:
         def batched_energy(params, strain, graph, positions):

@@ -483,6 +483,7 @@ class ServeEngine:
                     compute_magmom=getattr(pot, "compute_magmom", False),
                     skin=getattr(pot, "skin", 0.0),
                     num_threads=getattr(pot, "num_threads", None),
+                    kernels=getattr(pot, "kernels", None),
                     telemetry=getattr(pot, "telemetry", None))
                 self._spatial_lane_error = None
             except Exception as e:  # noqa: BLE001 - retried next request
@@ -626,7 +627,7 @@ class ServeEngine:
                   "rebuild_on_device", "rebuild_overflow_count",
                   "num_partitions", "n_cap", "e_cap",
                   "mesh_shape", "spatial_parts", "batch_parts",
-                  "halo_send_per_part"):
+                  "halo_send_per_part", "kernel_mode", "kernel_coverage"):
             if pot_stats and k in pot_stats:
                 setattr(rec, k, pot_stats[k])
         tel.emit(rec)

@@ -84,6 +84,13 @@ class StepRecord:
     # one cached abstract trace per runtime build, all registered passes)
     contract_error_count: int = 0    # unsuppressed ERROR findings
     contract_warning_count: int = 0  # unsuppressed WARNING findings
+    # fused-kernel dispatch of the traced step program (kernels/dispatch):
+    # "pallas" when any edge aggregation routed to the Pallas kernels,
+    # "xla" when all fell back, "" unknown (no trace observed yet)
+    kernel_mode: str = ""
+    # fraction of edge-aggregation call sites served by the fused Pallas
+    # path in the traced program (1.0 = fully fused, 0.0 = pure XLA)
+    kernel_coverage: float = 0.0
     flops_per_step: float = 0.0      # analytic estimate (utils/flops.py)
     mfu: float = 0.0                 # flops / (device_s * devices * peak)
 

@@ -17,7 +17,12 @@ and flags the anomaly classes this repo has actually hit:
 - **host-rebuild dominant** — a device-rebuild-capable run (some rebuilds
   DID run on device) that still pays most of its rebuilds on the host:
   capacity overflows or structure churn are defeating the device-resident
-  path, so the hot loop keeps stalling on host FPIS rebuilds.
+  path, so the hot loop keeps stalling on host FPIS rebuilds;
+- **kernel-fallback dominant** — an accelerator run (device memory stats
+  reported) whose traced programs mostly took the pure-XLA
+  edge-aggregation path instead of the fused Pallas kernels
+  (kernels/dispatch): the kill switch or per-object ``kernels=False``
+  is likely left on.
 """
 
 from __future__ import annotations
@@ -105,6 +110,10 @@ class Report:
                 bits.append(
                     f"frontier_edge_frac={c['mean_frontier_edge_frac']:.3f}")
             out.append("halo pipeline: " + " ".join(bits))
+        if "kernel_modes" in c:
+            out.append(
+                f"fused kernels: mode={','.join(c['kernel_modes'])} "
+                f"coverage mean={c['mean_kernel_coverage']:.2f}")
         if "mean_mfu" in c:
             out.append(f"mfu: mean={c['mean_mfu']:.3f} max={c['max_mfu']:.3f}")
         if c.get("buckets"):
@@ -220,6 +229,14 @@ def aggregate(
     colls = [r.collective_count for r in records if r.collective_count > 0]
     if colls:
         c["collective_count"] = max(colls)
+    # fused-kernel dispatch (kernels/dispatch): which modes the run's
+    # traced programs used and the mean fraction of edge aggregations
+    # served by the Pallas path ("" = producer observed no trace)
+    kmodes = sorted({r.kernel_mode for r in records if r.kernel_mode})
+    if kmodes:
+        kcovs = [r.kernel_coverage for r in records if r.kernel_mode]
+        c["kernel_modes"] = kmodes
+        c["mean_kernel_coverage"] = sum(kcovs) / len(kcovs)
     # static contract audit (distmlip_tpu.analysis findings riding the
     # records): any error-severity finding on a shipped step program is an
     # anomaly — the program violates a stated runtime invariant
@@ -355,6 +372,21 @@ def aggregate(
                 f"bucket {key}: mean occupancy {occ:.2f} over {b['steps']} "
                 f"step(s) below {occupancy_floor:.2f} — tune BucketPolicy "
                 f"growth/base or batch more structures per request"))
+    # kernel-fallback-dominant: an accelerator run (device memory stats
+    # reported — CPU backends report none) whose traced programs mostly
+    # took the pure-XLA edge-aggregation path: the chips are paying the
+    # materialized (E, width) HBM round-trips the Pallas kernels exist to
+    # remove (DISTMLIP_KERNELS=0 left on, or per-object kernels=False)
+    if kmodes:
+        on_accel = any(r.device_memory for r in records if r.kernel_mode)
+        if on_accel and c["mean_kernel_coverage"] < 0.5:
+            rep.anomalies.append(Anomaly(
+                "kernel_fallback_dominant", 0,
+                f"mean fused-kernel coverage "
+                f"{c['mean_kernel_coverage']:.2f} (< 0.5) on an "
+                f"accelerator run (modes: {','.join(kmodes)}) — edge "
+                f"aggregations are falling back to the pure-XLA path; "
+                f"check DISTMLIP_KERNELS / per-potential kernels= flags"))
     # host-rebuild-dominant: the run proved device-rebuild capability (at
     # least one on-device rebuild) yet paid the majority of its rebuilds on
     # the host — overflows or churn are defeating the device-resident path

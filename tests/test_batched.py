@@ -203,20 +203,28 @@ def test_parity_one_atom_and_empty_padded_slot(rng):
 
 
 @pytest.mark.tier1
-def test_compile_count_bounded_over_random_size_stream(rng):
+def test_compile_count_bounded_over_random_size_stream():
     """A stream of >= 20 randomly sized requests must hit a small fixed
     set of compiled executables (one per geometric shape bucket), not one
-    compile per novel (n_atoms, n_edges) shape."""
+    compile per novel (n_atoms, n_edges) shape.
+
+    Local rng (not the session fixture): the replay assertion below is
+    exactly-zero, and the session generator's state depends on suite
+    order — a different draw can legitimately land an edge count on a
+    different bucket rung."""
+    rng = np.random.default_rng(7)
     model = PairPotential(PairConfig(cutoff=3.0, kind="lj"))
     params = model.init()
     bp = BatchedPotential(model, params)
     sizes = rng.integers(6, 180, size=20)
     seen_keys = set()
+    stream = []
     for n in sizes:
         box = max(4.0, (float(n) ** (1 / 3)) * 2.6)
         pos = rng.random((int(n), 3)) * box
         atoms = Atoms(numbers=np.full(int(n), 14), positions=pos,
                       cell=np.eye(3) * box)
+        stream.append(atoms)
         bp.calculate([atoms])
         seen_keys.add(bp.last_bucket_key)
     # compiles == distinct shape buckets, bounded by the geometric ladder:
@@ -230,13 +238,13 @@ def test_compile_count_bounded_over_random_size_stream(rng):
         f"{bp.compile_count} compiles for 20 requests "
         f"(buckets: {sorted(seen_keys)})")
     assert bp.compile_count < 20
-    # replaying the same stream adds ZERO compiles (stateless buckets)
+    # replaying the SAME structures adds ZERO compiles (stateless
+    # buckets: same inputs -> same bucket keys -> warm jit cache). Fresh
+    # positions would not be a replay — an edge count near a rung
+    # boundary can legitimately cross it.
     before = bp.compile_count
-    for n in sizes[:5]:
-        box = max(4.0, (float(n) ** (1 / 3)) * 2.6)
-        pos = rng.random((int(n), 3)) * box
-        bp.calculate([Atoms(numbers=np.full(int(n), 14), positions=pos,
-                            cell=np.eye(3) * box)])
+    for atoms in stream[:5]:
+        bp.calculate([atoms.copy()])
     assert bp.compile_count == before
 
 

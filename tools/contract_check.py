@@ -2,8 +2,8 @@
 """Static program-contract checker: trace, run passes, gate CI.
 
     python tools/contract_check.py [--models chgnet,tensornet,mace,escn]
-        [--programs SUBSTR] [--passes p1,p2] [--lint] [--only-lint]
-        [--list-passes] [--json] [--verbose]
+        [--programs SUBSTR] [--passes p1,p2] [--kernels {auto,on,off}]
+        [--lint] [--only-lint] [--list-passes] [--json] [--verbose]
 
 Builds small test systems, traces the REAL programs the runtime ships —
 for every model the forward total-energy and value_and_grad potential at
@@ -17,6 +17,14 @@ Model programs are traced under ``jax.experimental.enable_x64`` so f64
 leaks stay visible instead of being silently canonicalized to f32 (the
 ``dtype_discipline`` pass ignores weak-typed python scalars, so a clean
 fp32 program stays clean under x64).
+
+``--kernels on`` traces every program with the Pallas fused-kernel
+dispatch FORCED on (kernels/dispatch.force_kernel_mode) — the exact
+program a TPU run ships, pallas_call bodies included (the jaxpr walker
+recurses into them; no chip or compile needed). ``off`` forces the
+pure-XLA fallback; ``auto`` (default) leaves the env/backend routing
+alone. CI runs both: the contracts must hold on BOTH sides of the
+dispatch.
 
 ``--lint`` additionally runs the repo-specific AST lint
 (:mod:`distmlip_tpu.analysis.lint`) over the package + tools, and chains
@@ -305,6 +313,10 @@ def main(argv=None) -> int:
                     help="only check programs whose name contains SUBSTR")
     ap.add_argument("--passes", default=None,
                     help="comma list of registered passes (default: all)")
+    ap.add_argument("--kernels", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="trace with Pallas fused kernels forced on/off "
+                         "(auto: env/backend routing)")
     ap.add_argument("--lint", action="store_true",
                     help="also run the AST lint (+ruff when installed)")
     ap.add_argument("--only-lint", action="store_true",
@@ -354,19 +366,27 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    report = {"programs": {}, "passes": [p.name for p in passes]}
+    report = {"programs": {}, "passes": [p.name for p in passes],
+              "kernels": args.kernels}
     all_findings = []
 
     if not args.only_lint:
+        from distmlip_tpu.kernels import force_kernel_mode
+
+        # "on" forces the real (non-interpret) Pallas program — tracing
+        # needs no chip; "off" pins the XLA fallback; "auto" leaves the
+        # env/backend routing (xla on this CPU entry point)
+        forced = {"auto": None, "on": "pallas", "off": "xla"}[args.kernels]
         want = (_want_all if not args.programs
                 else (lambda n: args.programs in n))
         programs = []
-        for name in models:
-            _trace_model_programs(name, programs, want)
-        if want("packed_batch[tensornet][B=4]"):
-            _trace_packed_batch(programs)
-        if want("device_md[pair][1x1]"):
-            _trace_device_md(programs)
+        with force_kernel_mode(forced):
+            for name in models:
+                _trace_model_programs(name, programs, want)
+            if want("packed_batch[tensornet][B=4]"):
+                _trace_packed_batch(programs)
+            if want("device_md[pair][1x1]"):
+                _trace_device_md(programs)
         for prog in programs:
             findings = run_passes(prog, passes)
             all_findings.extend(findings)

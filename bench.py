@@ -474,6 +474,20 @@ def _main_measured():
                 batched_extras[f"structures_per_sec_b{B}"] = round(
                     B / dt_b, 2)
             batched_extras["batched_compiles"] = bpot.compile_count
+            # static-HBM-planner accuracy on real hardware: predicted
+            # per-device peak vs the backend's measured peak residency
+            # (the JSONL StepRecords carry the same fields per step, so
+            # telemetry_report's hbm_estimator_drift check sees them;
+            # this scalar keeps the ratio in the BENCH round artifact)
+            from distmlip_tpu.utils.memory import measured_peak_bytes
+
+            est_b = int(getattr(bpot, "last_est_peak_bytes", 0))
+            measured_b = measured_peak_bytes()
+            if est_b:
+                batched_extras["est_peak_bytes"] = est_b
+            if est_b and measured_b:
+                batched_extras["hbm_est_over_measured"] = round(
+                    est_b / measured_b, 3)
         except Exception as e:  # noqa: BLE001 - batched is additive
             batched_extras["batched_error"] = f"{type(e).__name__}: {e}"[:160]
 

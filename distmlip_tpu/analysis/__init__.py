@@ -11,10 +11,15 @@ bound. This package proves them statically — on CPU, in CI, with no chip:
   pjit/scan/while/cond/remat/shard_map sub-jaxprs, tracking named_scope
   stacks and control-flow paths) that ``parallel/audit.py`` is now a thin
   compatibility shim over;
+- :mod:`~distmlip_tpu.analysis.memory` — the static HBM planner:
+  buffer-liveness peak-memory analysis (:func:`analyze_memory` ->
+  :class:`MemoryPlan`) driving the ``memory_budget`` pass, memory-aware
+  autobatching and the ``est_peak_bytes`` telemetry;
 - :mod:`~distmlip_tpu.analysis.passes` — the registered
   :class:`ContractPass`es (collective_placement, host_sync,
-  dtype_discipline, scatter_hints, recompile_hazard, dead_compute), each
-  returning typed :class:`Finding`s with severity and scope location;
+  dtype_discipline, scatter_hints, recompile_hazard, dead_compute,
+  memory_budget), each returning typed :class:`Finding`s with severity
+  and scope location;
 - :mod:`~distmlip_tpu.analysis.lint` — AST rules jaxprs can't see
   (host pulls in device-path code, wallclock in jit, unused imports);
 - ``tools/contract_check.py`` — the CLI that traces the real programs
@@ -31,10 +36,12 @@ from .passes import (REGISTRY, ContractPass, Program, get_passes, register,
                      run_passes)
 from . import ir
 from .lint import lint_file, lint_paths
+from .memory import MemoryPlan, analyze_memory, oracle_peak_bytes
 
 __all__ = [
     "Finding", "Severity", "error_count", "warning_count", "exit_code",
     "format_findings", "apply_suppressions", "clear_suppression_cache",
     "ContractPass", "Program", "REGISTRY", "register", "get_passes",
     "run_passes", "ir", "lint_file", "lint_paths",
+    "MemoryPlan", "analyze_memory", "oracle_peak_bytes",
 ]

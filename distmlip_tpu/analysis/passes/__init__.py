@@ -127,3 +127,4 @@ from . import dtype_discipline      # noqa: E402,F401
 from . import scatter_hints         # noqa: E402,F401
 from . import recompile_hazard      # noqa: E402,F401
 from . import dead_compute          # noqa: E402,F401
+from . import memory_budget         # noqa: E402,F401

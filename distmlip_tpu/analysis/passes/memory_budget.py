@@ -1,0 +1,99 @@
+"""Memory-budget pass: a traced program's estimated peak vs the HBM limit.
+
+Runs the static HBM planner (:mod:`distmlip_tpu.analysis.memory`) over the
+program and gates on the per-device peak live-byte estimate:
+
+- **ERROR** when the estimated peak exceeds ``memory_budget_frac`` (default
+  0.9) of the budget — the program is expected to OOM (or to leave the
+  runtime no headroom for the prefetch's transient 2x window);
+- **WARNING** when a single transient window (both sides of a loop carry /
+  scatter copy / speculative build live at once) exceeds
+  ``transient_warn_frac`` (default 0.5) of the budget: the program fits at
+  steady state but one eqn's spike owns most of the chip;
+- **INFO** always: the estimated peak, its top live-set contributor, and
+  the headroom fraction — the number ``StepRecord.est_peak_bytes``
+  telemetry compares against measured ``bytes_in_use``.
+
+Config keys (``Program.config``):
+
+- ``bytes_limit`` — the per-device HBM budget in bytes. Default: the
+  worst device's reported ``bytes_limit``
+  (``utils.memory.device_bytes_limit``); on backends reporting none (CPU)
+  the pass emits the INFO estimate only — there is nothing to gate.
+- ``memory_budget_frac`` — ERROR threshold as a fraction of the budget.
+- ``transient_warn_frac`` — WARNING threshold for one transient window.
+- ``donated_invars`` — invar indices donated at dispatch (their buffers
+  die at last use; tracing does not record donation).
+
+ERROR findings anchor to the top temp contributor's trace site, so
+``# contract: allow(memory_budget)`` at that line is the audited-exception
+idiom (same as every other pass)."""
+
+from __future__ import annotations
+
+from ..memory import analyze_memory
+from . import ContractPass, Program, Severity, register
+
+
+@register
+class MemoryBudgetPass(ContractPass):
+    name = "memory_budget"
+    description = ("estimated per-device peak live bytes vs the HBM "
+                   "budget (static OOM gate)")
+
+    def run(self, program: Program) -> list:
+        cfg = program.config
+        plan = analyze_memory(program.jaxpr,
+                              donated=cfg.get("donated_invars", ()))
+        # cache the plan on the program so callers that want the numbers
+        # (calculator._contract_audit's est_peak_bytes telemetry,
+        # load_test's summary) read it back instead of re-walking a
+        # multi-thousand-eqn jaxpr for one integer
+        cfg["_memory_plan"] = plan
+        limit = cfg.get("bytes_limit")
+        if limit is None:
+            from ...utils.memory import device_bytes_limit
+
+            limit = device_bytes_limit()
+        frac = float(cfg.get("memory_budget_frac", 0.9))
+        t_frac = float(cfg.get("transient_warn_frac", 0.5))
+
+        top = plan.contributors[0] if plan.contributors else None
+        top_loc = (top.location if top is not None
+                   and top.kind == "temp" else None)
+        findings = []
+        if limit:
+            budget = frac * float(limit)
+            if plan.peak_bytes > budget:
+                owners = "; ".join(
+                    c.render().strip() for c in plan.contributors[:3])
+                findings.append(self.finding(
+                    Severity.ERROR,
+                    f"estimated peak {plan.peak_bytes / 2**20:.1f} MiB "
+                    f"exceeds {frac:.0%} of the {limit / 2**30:.2f} GiB "
+                    f"budget — top live-set contributors: {owners}",
+                    rule="over-budget", location=top_loc))
+            else:
+                for t in plan.transients:
+                    if t.nbytes > t_frac * float(limit):
+                        findings.append(self.finding(
+                            Severity.WARNING,
+                            f"transient window of "
+                            f"{t.nbytes / 2**20:.1f} MiB "
+                            f"({t.primitive}) exceeds {t_frac:.0%} of the "
+                            f"budget — one eqn's spike owns most of the "
+                            f"chip", rule="large-transient",
+                            location=t.location))
+                        break           # the largest window suffices
+        headroom = plan.headroom_frac(limit)
+        hr = (f", headroom {headroom:.0%}" if headroom is not None
+              else ", no device bytes_limit reported")
+        top_s = f" — top: {top.render().strip()}" if top is not None else ""
+        findings.append(self.finding(
+            Severity.INFO,
+            f"estimated per-device peak {plan.peak_bytes / 2**20:.1f} MiB "
+            f"(args {plan.arg_bytes / 2**20:.1f} + consts "
+            f"{plan.const_bytes / 2**20:.1f} + temps "
+            f"{plan.temp_peak_bytes / 2**20:.1f}){hr}{top_s}",
+            rule="peak-estimate"))
+        return findings

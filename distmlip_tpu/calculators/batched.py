@@ -67,6 +67,20 @@ class BatchedPotential:
     halo exchange on the spatial axis only. The single-device behavior
     (mesh=None) is unchanged. On-device packed refresh is host-side only
     for mesh placements (multi-partition graphs repack on the host).
+
+    Memory-aware autobatching (``hbm_budget_bytes``/``hbm_budget_frac``/
+    ``memory_model``): every fresh compile additionally runs the static
+    HBM planner (``analysis/memory.analyze_memory`` — one abstract trace,
+    no device work) over the just-compiled program and calibrates the
+    ``BucketPolicy`` bytes model with the per-device peak estimate
+    (cached per shape bucket). ``hbm_budget_bytes`` is the per-device HBM
+    budget consumers fill batches toward (``ServeEngine`` admission +
+    ``plan_batch``); default: ``hbm_budget_frac`` (0.8) of the backend's
+    reported ``bytes_limit``, None on backends reporting none (CPU) —
+    budget checks are then skipped. ``memory_model=False`` disables the
+    calibration trace entirely. ``last_est_peak_bytes`` /
+    ``hbm_headroom_frac`` ride ``last_stats`` and the telemetry records
+    so estimator drift vs measured ``bytes_in_use`` is visible.
     """
 
     def __init__(
@@ -83,6 +97,9 @@ class BatchedPotential:
         mesh=None,
         kernels=None,
         telemetry=None,
+        hbm_budget_bytes: int | None = None,
+        hbm_budget_frac: float = 0.8,
+        memory_model: bool = True,
     ):
         self.model = model
         self.params = params
@@ -139,6 +156,20 @@ class BatchedPotential:
         self.last_stats: dict = {}
         self._step_counter = 0
         self._last_compile_count = 0
+        # memory-aware autobatching: per-device HBM budget + the static
+        # planner's calibration (per compiled shape bucket)
+        self.memory_model = bool(memory_model)
+        if hbm_budget_bytes is None:
+            from ..utils.memory import device_bytes_limit
+
+            limit = device_bytes_limit()
+            if limit:
+                hbm_budget_bytes = int(limit * float(hbm_budget_frac))
+        self.hbm_budget_bytes = (int(hbm_budget_bytes)
+                                 if hbm_budget_bytes else None)
+        self._est_peak_by_bucket: dict[str, int] = {}
+        self.last_est_peak_bytes = 0     # 0 = no estimate yet
+        self.last_hbm_headroom_frac = 0.0
         # serving: the ServeEngine scheduler thread and direct callers may
         # share one BatchedPotential — serialize calculate() so the Verlet
         # cache (check-then-use) and compile-cache counters stay coherent
@@ -295,6 +326,49 @@ class BatchedPotential:
         self._cache = (graph2, host, keys)
         return graph2, host, positions, time.perf_counter() - t0
 
+    def _calibrate_memory(self, graph, positions, structures) -> None:
+        """Run the static HBM planner over the just-compiled program and
+        record the per-device peak estimate — per shape bucket here (for
+        telemetry on cache hits) and on the BucketPolicy bytes model (for
+        the scheduler's bytes-budget fill). Best-effort: an analyzer
+        fault must never fail the batch."""
+        try:
+            import jax
+
+            from ..analysis.memory import analyze_memory
+            from ..partition.batch import bucket_key
+
+            jaxpr = jax.make_jaxpr(self._potential)(
+                self.params, graph, positions)
+            plan = analyze_memory(jaxpr)
+            self._est_peak_by_bucket[bucket_key(graph)] = plan.peak_bytes
+            n_total = sum(len(a) for a in structures)
+            if hasattr(self.caps, "calibrate_bytes"):
+                self.caps.calibrate_bytes(
+                    self.caps.get("nodes", n_total), plan.peak_bytes)
+        except Exception:  # noqa: BLE001 - planning must never fail a step
+            pass
+
+    def _headroom(self, est_peak_bytes: int, stats: dict | None) -> float:
+        """Remaining HBM fraction after the estimated peak, against the
+        device limit from the given stats snapshot (or the configured
+        budget when the backend reports no limit). 0.0 = unknown."""
+        if not est_peak_bytes:
+            return 0.0
+        from ..utils.memory import device_bytes_limit
+
+        limit = device_bytes_limit(stats or {}) or self.hbm_budget_bytes
+        if not limit:
+            return 0.0
+        return 1.0 - est_peak_bytes / limit
+
+    def estimate_batch_bytes(self, total_atoms: int) -> int | None:
+        """Per-device peak-byte estimate for a batch totalling
+        ``total_atoms`` atoms, from the calibrated BucketPolicy bytes
+        model (None until the first compile calibrates it)."""
+        est = getattr(self.caps, "estimate_batch_bytes", None)
+        return est(total_atoms) if est is not None else None
+
     def calculate(self, structures) -> list:
         """Evaluate a batch; returns one result dict per input structure
         (energy eV, forces eV/Å, stress eV/Å^3 ASE sign convention, plus
@@ -345,6 +419,11 @@ class BatchedPotential:
             if kc.total:  # a fresh trace happened (new shape bucket)
                 self._kernel_mode = kc.mode
                 self._kernel_coverage = kc.coverage
+                # new shape bucket: calibrate the bytes model with the
+                # static planner's per-device peak for THIS program
+                # (host-side abstract trace; once per bucket)
+                if self.memory_model:
+                    self._calibrate_memory(graph, positions, structures)
             # flat shard-major slots -> input structure order (identity for
             # the single-shard pack)
             slots = host.structure_slots
@@ -391,11 +470,25 @@ class BatchedPotential:
         self.last_stats["rebuild_on_device"] = int(refreshed)
         self.last_stats["rebuild_overflow_count"] = self.rebuild_overflow_count
         self.last_bucket_key = self.last_stats.get("bucket_key", "")
-        self._emit_record(host, len(structures), reused, refreshed, t3 - t0)
+        # bucket-cached peak estimate (cache hits reuse the compile-time
+        # calibration) + headroom against the device limit/budget — ONE
+        # backend memory-stats sweep serves both the headroom and the
+        # record's device_memory field
+        from ..utils.memory import device_memory_stats
+
+        mem_stats = device_memory_stats()
+        est = self._est_peak_by_bucket.get(self.last_bucket_key, 0)
+        self.last_est_peak_bytes = est
+        self.last_hbm_headroom_frac = self._headroom(est, mem_stats)
+        self.last_stats["est_peak_bytes"] = est
+        self.last_stats["hbm_headroom_frac"] = self.last_hbm_headroom_frac
+        self._emit_record(host, len(structures), reused, refreshed, t3 - t0,
+                          mem_stats)
         return results
 
     def _emit_record(self, host, n_structures: int, reused: bool,
-                     refreshed: bool, total_s: float) -> None:
+                     refreshed: bool, total_s: float,
+                     mem_stats: dict | None = None) -> None:
         self._step_counter += 1
         tel = self.telemetry
         if tel is None or not tel.wants_records():
@@ -415,6 +508,9 @@ class BatchedPotential:
                                 else 0.0),
             kernel_mode=self._kernel_mode,
             kernel_coverage=self._kernel_coverage,
+            est_peak_bytes=self.last_est_peak_bytes,
+            hbm_headroom_frac=self.last_hbm_headroom_frac,
+            device_memory=dict(mem_stats or {}),
         )
         import dataclasses
 

@@ -45,41 +45,11 @@ from ..telemetry import StepRecord, annotate
 from .atoms import EV_A3_TO_GPA, Atoms, map_species, max_displacement
 
 
-def _device_memory_stats() -> dict:
-    """Per-device ``bytes_in_use`` (and ``bytes_limit`` where reported) from
-    backends that expose memory stats (TPU/GPU; CPU returns {}). Keys are
-    ``dev<i>_bytes_in_use``-style."""
-    import jax
-
-    out = {}
-    try:
-        for d in jax.local_devices():
-            stats = d.memory_stats()
-            if stats and "bytes_in_use" in stats:
-                out[f"dev{d.id}_bytes_in_use"] = int(stats["bytes_in_use"])
-                if "peak_bytes_in_use" in stats:
-                    out[f"dev{d.id}_peak_bytes_in_use"] = int(
-                        stats["peak_bytes_in_use"])
-                if "bytes_limit" in stats:
-                    out[f"dev{d.id}_bytes_limit"] = int(stats["bytes_limit"])
-    except Exception:  # noqa: BLE001 - telemetry must never fail a step
-        return {}
-    return out
-
-
-def _hbm_usage_frac(stats: dict | None = None) -> float | None:
-    """Worst-device bytes_in_use / bytes_limit, or None when the backend
-    reports no limits (CPU)."""
-    stats = _device_memory_stats() if stats is None else stats
-    worst = None
-    for k, used in stats.items():
-        if not k.endswith("_bytes_in_use") or "peak" in k:
-            continue
-        limit = stats.get(k.replace("_bytes_in_use", "_bytes_limit"), 0)
-        if limit > 0:
-            frac = used / limit
-            worst = frac if worst is None else max(worst, frac)
-    return worst
+# one shared implementation (utils/memory.py) serves the calculator, the
+# batched engine, the telemetry report and the static HBM planner; the
+# historical private names stay importable (and monkeypatchable) here
+from ..utils.memory import device_memory_stats as _device_memory_stats
+from ..utils.memory import hbm_usage_frac as _hbm_usage_frac
 
 
 def _discard_abandoned_build(future):
@@ -122,9 +92,14 @@ class DistPotential:
         ``energy_and_aux_fn``, ride the sitewise readout on the energy
         forward (no second full pass). False falls back to the deprecated
         separate ``make_site_fn`` program.
-    prefetch_hbm_frac : skip the speculative background rebuild while the
-        worst device's bytes_in_use exceeds this fraction of bytes_limit
-        (the prefetch transiently double-books graph HBM); skips are
+    prefetch_hbm_frac : HBM guard scale for the speculative background
+        rebuild, which transiently double-books graph HBM. PREDICTIVE
+        where the backend reports a ``bytes_limit``: the build is skipped
+        when current occupancy PLUS the cached graph's statically
+        estimated per-device residency would exceed ``2x`` this fraction
+        (so a small graph on a busy device is no longer falsely vetoed);
+        where no limit is reported, falls back to the historical rule
+        (skip while occupancy alone exceeds the fraction). Skips are
         counted in ``prefetch_skipped_hbm`` and surfaced in telemetry.
     device_rebuild : "auto" (default) rebuilds the neighbor graph ON DEVICE
         when the Verlet skin cache invalidates — single-partition,
@@ -520,18 +495,49 @@ class DistPotential:
         pos0 = self._cache[3]
         if self._disp_frac(pos0, atoms.positions) < self.prefetch_frac:
             return
-        # HBM-aware guard: with the live graph already holding a large
-        # slice of HBM, the speculative build's 2x-residency window risks
-        # an OOM — skip it (the eventual rebuild runs synchronously) and
-        # record the veto instead of silently double-booking HBM
+        # HBM-aware guard, PREDICTIVE: the speculative build transiently
+        # adds ~one graph of per-device residency. When the build's
+        # footprint is statically estimable (bytes_limit known), skip only
+        # if current occupancy + the estimated build residency would pass
+        # 2x prefetch_hbm_frac (the historical ceiling the 1/3 default
+        # implied for a graph-dominated live set) — a tiny graph on a busy
+        # chip no longer gets a false veto. Without a limit estimate fall
+        # back to the historical occupancy-only rule.
         frac = _hbm_usage_frac()
-        if frac is not None and frac > self.prefetch_hbm_frac:
-            self.prefetch_skipped_hbm += 1
-            self._prefetch_skip_hbm_flag = True
-            return
+        if frac is not None:
+            add = self._estimate_prefetch_frac()
+            # predicted ceiling capped at 0.9: whatever the knob says,
+            # a speculative build pushing predicted occupancy past 90%
+            # is vetoed (the estimate excludes neighbor-build
+            # temporaries, so real residency runs higher)
+            ceiling = min(2.0 * self.prefetch_hbm_frac, 0.9)
+            veto = (frac + add > ceiling if add is not None
+                    else frac > self.prefetch_hbm_frac)
+            if veto:
+                self.prefetch_skipped_hbm += 1
+                self._prefetch_skip_hbm_flag = True
+                return
         snapshot = atoms.copy()
         self._prefetch = (
             self._get_executor().submit(self._build_graph, snapshot), snapshot)
+
+    def _estimate_prefetch_frac(self) -> float | None:
+        """Statically estimated PER-DEVICE residency the speculative build
+        would add, as a fraction of the device bytes_limit: the cached
+        graph's array bytes spread over the partitions (the prefetched
+        graph has the same capacities until a cap grows). None when no
+        device reports a limit (CPU) or there is no cached graph."""
+        from ..utils.memory import device_bytes_limit
+
+        limit = device_bytes_limit()
+        if not limit or self._cache is None:
+            return None
+        import jax
+
+        graph = self._cache[0]
+        total = sum(int(getattr(leaf, "nbytes", 0))
+                    for leaf in jax.tree.leaves(graph))
+        return total / max(self.num_partitions or 1, 1) / limit
 
     def _adopt_prefetch(self, atoms: Atoms):
         """Take the background-built graph if it is valid for the CURRENT
@@ -849,7 +855,15 @@ class DistPotential:
             pass
         (rec.collective_count, rec.contract_error_count,
          rec.contract_warning_count, rec.kernel_mode,
-         rec.kernel_coverage) = self._contract_audit()
+         rec.kernel_coverage, rec.est_peak_bytes) = self._contract_audit()
+        if rec.est_peak_bytes:
+            from ..utils.memory import device_bytes_limit
+
+            # reuse the record's snapshot — an empty dict means the
+            # backend reports nothing, NOT "go sweep the devices again"
+            limit = device_bytes_limit(rec.device_memory)
+            if limit:
+                rec.hbm_headroom_frac = 1.0 - rec.est_peak_bytes / limit
         tel.emit(rec)
 
     def _collective_count(self) -> int:
@@ -860,13 +874,15 @@ class DistPotential:
 
     def _contract_audit(self) -> tuple:
         """(collective_count, contract_errors, contract_warnings,
-        kernel_mode, kernel_coverage) of the step program: ONE cached
-        abstract trace per runtime build feeds the collective tally, every
-        registered contract pass (distmlip_tpu.analysis) AND the
-        fused-kernel dispatch tally (kernels/dispatch.counting — the
+        kernel_mode, kernel_coverage, est_peak_bytes) of the step program:
+        ONE cached abstract trace per runtime build feeds the collective
+        tally, every registered contract pass (distmlip_tpu.analysis),
+        the fused-kernel dispatch tally (kernels/dispatch.counting — the
         dispatch decision is made at trace time, so counting during the
-        audit trace measures exactly what the compiled program runs).
-        (0, 0, 0, "", 0.0) when tracing is not possible (no cached
+        audit trace measures exactly what the compiled program runs) AND
+        the static HBM planner's per-device peak estimate
+        (analysis/memory.analyze_memory) riding the same jaxpr.
+        (0, 0, 0, "", 0.0, 0) when tracing is not possible (no cached
         graph)."""
         cached = getattr(self, "_collective_count_cache", None)
         if cached is not None and cached[0] is self._potential:
@@ -876,14 +892,15 @@ class DistPotential:
             # the cache predates the first observed dispatch tally (e.g.
             # audit traced on a warm pjit cache before any fresh trace):
             # refresh the kernel fields, keep the findings
-            out = out[:3] + (self._kernel_mode, self._kernel_coverage)
+            out = out[:3] + (self._kernel_mode, self._kernel_coverage,
+                             out[5])
             self._collective_count_cache = (self._potential, out)
             return out
         if (not self.collective_audit or self._cache is None
                 or self._potential is None):
             # no cached graph to trace (skin=0 runs) — the observed
             # dispatch tally is still authoritative
-            return (0, 0, 0, self._kernel_mode, self._kernel_coverage)
+            return (0, 0, 0, self._kernel_mode, self._kernel_coverage, 0)
         try:
             import jax
 
@@ -903,19 +920,32 @@ class DistPotential:
                 kmode, kcov = self._kernel_mode, self._kernel_coverage
         except Exception:  # noqa: BLE001 - telemetry must never fail a step
             self._collective_count_cache = (
-                self._potential, (0, 0, 0, "", 0.0))
-            return (0, 0, 0, "", 0.0)
+                self._potential, (0, 0, 0, "", 0.0, 0))
+            return (0, 0, 0, "", 0.0, 0)
         try:
             from ..analysis import (Program, error_count, run_passes,
                                     warning_count)
 
-            findings = run_passes(Program(
-                name="step_program", jaxpr=jaxpr,
-                tags=frozenset({"grad"})))
+            prog = Program(name="step_program", jaxpr=jaxpr,
+                           tags=frozenset({"grad"}))
+            findings = run_passes(prog)
+            # the memory_budget pass caches its plan on the program —
+            # ONE liveness walk serves both the findings and the
+            # est_peak_bytes telemetry
+            plan = prog.config.get("_memory_plan")
+            est_peak = int(plan.peak_bytes) if plan is not None else 0
             out = (n, error_count(findings), warning_count(findings),
-                   kmode, kcov)
+                   kmode, kcov, est_peak)
         except Exception:  # noqa: BLE001 - a broken contract pass must not
-            out = (n, 0, 0, kmode, kcov)  # zero the findings tally only
+            # zero the findings tally only; the HBM plan is recomputed
+            # directly so the estimate survives a broken pass
+            try:
+                from ..analysis.memory import analyze_memory
+
+                est_peak = int(analyze_memory(jaxpr).peak_bytes)
+            except Exception:  # noqa: BLE001 - planner fault too
+                est_peak = 0
+            out = (n, 0, 0, kmode, kcov, est_peak)
         self._collective_count_cache = (self._potential, out)
         return out
 

@@ -122,6 +122,14 @@ class BucketPolicy:
     shapes, and a bounded size range produces a bounded shape set. Small
     dimensions (batch slots) use ``base=1, multiple=1`` via
     :meth:`get_small` so a 3-structure batch doesn't pad to 128 slots.
+
+    The policy additionally carries the memory-aware autobatching bytes
+    model: :meth:`calibrate_bytes` records the static HBM planner's
+    per-device peak estimate per node rung (BatchedPotential feeds it on
+    every fresh compile), and :meth:`estimate_batch_bytes` answers "how
+    many bytes would a batch of N total atoms cost" for the scheduler's
+    bytes-budget fill (``serve.scheduler.plan_batch``). Shapes remain
+    history-free; only BYTES estimates learn.
     """
 
     def __init__(self, base: int = 128, growth: float = 2.0 ** 0.5,
@@ -131,9 +139,88 @@ class BucketPolicy:
         self.base = int(base)
         self.growth = float(growth)
         self.multiple = int(multiple)
+        # memory-aware autobatching: per-device peak-byte calibration per
+        # node-capacity rung, fed by the static HBM planner
+        # (analysis/memory.analyze_memory) each time a new shape bucket
+        # compiles. The ladder itself stays stateless — this cache only
+        # refines BYTES estimates, never shapes.
+        self._bytes_by_cap: dict[int, int] = {}
+        self._bytes_lock = threading.Lock()
 
     def get(self, name: str, needed: int) -> int:
         return geometric_bucket(needed, self.base, self.growth, self.multiple)
+
+    # ---- bytes-per-structure model (memory-aware autobatching) ----
+
+    def calibrate_bytes(self, node_cap: int, peak_bytes: int) -> None:
+        """Record the analyzer's estimated per-device peak for a batch
+        program whose node-capacity rung is ``node_cap``. Keeps the WORST
+        observed peak per rung (edge-heavy packs of the same rung must not
+        shrink the estimate)."""
+        node_cap, peak_bytes = int(node_cap), int(peak_bytes)
+        if node_cap <= 0 or peak_bytes <= 0:
+            return
+        with self._bytes_lock:
+            prev = self._bytes_by_cap.get(node_cap, 0)
+            if peak_bytes > prev:
+                self._bytes_by_cap[node_cap] = peak_bytes
+
+    def bytes_calibrated(self) -> bool:
+        with self._bytes_lock:
+            return bool(self._bytes_by_cap)
+
+    def has_calibrated_rung(self, total_atoms: int) -> bool:
+        """Whether ``total_atoms``'s own node rung has a MEASURED peak
+        (vs an extrapolated guess). Hard admission decisions key on this:
+        rejecting on extrapolation would livelock a lane whose first
+        calibration happened to land over budget — nothing would ever be
+        admitted to compile the rung and correct the guess."""
+        cap = self.get("nodes", max(int(total_atoms), 1))
+        with self._bytes_lock:
+            return cap in self._bytes_by_cap
+
+    def estimate_batch_bytes(self, total_atoms: int) -> int | None:
+        """Estimated per-device peak bytes of a batch totalling
+        ``total_atoms`` atoms: the calibrated peak of its node rung when
+        that exact rung has compiled before; otherwise an estimate that
+        errs UP — over-admitting is the failure mode that OOMs. With two
+        or more calibrated rungs, an affine fit ``resident + k * cap``
+        through the extreme rungs (a program's peak has a batch-size-
+        independent resident term — params, consts — that a pure
+        bytes-per-atom slope would wrongly scale away on SMALL batches);
+        with one rung, linear scaling up and the observed peak as a hard
+        floor below it (a never-compiled small batch is assumed no
+        cheaper than the cheapest batch ever measured — conservative by
+        design; its own first compile replaces the guess with the exact
+        rung). None until any calibration exists (callers then skip the
+        budget check rather than trust a made-up constant)."""
+        cap = self.get("nodes", max(int(total_atoms), 1))
+        with self._bytes_lock:
+            exact = self._bytes_by_cap.get(cap)
+            if exact is not None:
+                # same floor as the fit path: a lightly-calibrated rung
+                # never estimates below a peak already OBSERVED at a
+                # smaller rung (an edge-heavy smaller pack bounds it)
+                return max(b for c, b in self._bytes_by_cap.items()
+                           if c <= cap)
+            if not self._bytes_by_cap:
+                return None
+            pts = sorted(self._bytes_by_cap.items())
+            floor = min(b for _, b in pts)
+            if len(pts) >= 2:
+                (c_lo, b_lo), (c_hi, b_hi) = pts[0], pts[-1]
+                k = max((b_hi - b_lo) / max(c_hi - c_lo, 1), 0.0)
+                resident = max(b_lo - k * c_lo, 0.0)
+                est = int(resident + k * cap) + 1
+                # the fit runs through the EXTREME rungs only — never
+                # estimate below a peak already OBSERVED at a smaller
+                # rung (an edge-heavy middle rung would otherwise admit
+                # a bigger batch as cheaper than its measured smaller
+                # sibling)
+                observed = [b for c, b in pts if c <= cap]
+                return max(est, *observed) if observed else est
+            coeff = max(b / c for c, b in pts)
+        return max(int(cap * coeff) + 1, floor)
 
     def get_small(self, needed: int) -> int:
         """Bucket for small count dimensions (e.g. batch size): next power

@@ -16,6 +16,13 @@ Robustness contract (tests/test_serve.py):
 - bounded queue with admission control: ``admission="reject"`` raises
   ``ServeRejected`` when the queue is full, ``"block"`` parks the caller
   until the scheduler frees a slot;
+- memory-aware admission: when the shared potential carries an HBM budget
+  (``BatchedPotential.hbm_budget_bytes``) and its calibrated bytes model
+  estimates that a submitted structure ALONE would exceed it, the request
+  is rejected at submit in BOTH admission modes (parking a request that
+  can never fit would hang the submitter forever); batch assembly fills
+  toward the same budget (``plan_batch(bytes_budget=...)``), so no
+  dispatched batch is ever estimated over budget;
 - per-request error isolation: a poison structure (non-finite positions,
   or anything that makes the batch raise) fails its OWN Future; the rest
   of the batch returns results and the engine thread survives;
@@ -52,7 +59,9 @@ ADMISSION_MODES = ("reject", "block")
 
 
 class ServeRejected(RuntimeError):
-    """Queue full under admission="reject" — the request was NOT enqueued."""
+    """The request was NOT enqueued: queue full under admission="reject",
+    or the structure's estimated HBM footprint alone exceeds the batched
+    lane's budget (rejected in both admission modes — it can never fit)."""
 
 
 class EngineClosed(RuntimeError):
@@ -328,6 +337,7 @@ class ServeEngine:
         with self._cv:
             if self._closed:
                 raise EngineClosed("submit() on a closed engine")
+            self._check_hbm_admission(atoms)
             if len(self._pending) >= self.max_queue:
                 if self.admission == "reject":
                     self.stats.rejected += 1
@@ -344,6 +354,41 @@ class ServeEngine:
             heapq.heappush(self._pending, req)
             self._cv.notify_all()
         return req.future
+
+    def _hbm_budget(self) -> int | None:
+        """The batched lane's per-device HBM budget (None: no budget)."""
+        return getattr(self.potential, "hbm_budget_bytes", None)
+
+    def _check_hbm_admission(self, atoms) -> None:
+        """Reject a structure whose MEASURED solo footprint (its own
+        calibrated rung) exceeds the batched lane's HBM budget — it
+        cannot fit any batch, so parking it (admission="block") would
+        hang the submitter forever. An over-budget EXTRAPOLATED estimate
+        admits: the planner ships it as a solo probe whose compile
+        calibrates the rung (rejecting on guesses could livelock the
+        lane after one over-budget calibration elsewhere). Routed
+        oversized structures (> max_batch_atoms) are exempt: they ride
+        the fallback lane, which this budget does not govern."""
+        budget = self._hbm_budget()
+        if budget is None:
+            return
+        n = len(atoms)
+        if self.max_batch_atoms is not None and n > self.max_batch_atoms:
+            return
+        caps = getattr(self.potential, "caps", None)
+        exact = getattr(caps, "has_calibrated_rung", None)
+        if exact is None or not exact(n):
+            return
+        est_fn = getattr(self.potential, "estimate_batch_bytes", None)
+        est = est_fn(n) if est_fn is not None else None
+        if est is not None and est > budget:
+            self.stats.rejected += 1
+            raise ServeRejected(
+                f"structure of {n} atoms is estimated at "
+                f"{est / 2**20:.1f} MiB peak — over the batched lane's "
+                f"{budget / 2**20:.1f} MiB HBM budget; partition it "
+                f"spatially (DistPotential / the engine's oversized "
+                f"lane via max_batch_atoms) instead")
 
     # ------------------------------------------------------------------
     # scheduler loop
@@ -373,11 +418,11 @@ class ServeEngine:
                 if not ready:
                     self._cv.wait(timeout=self._wait_timeout(now - oldest))
                     continue
-                batch, oversized = self._assemble_locked()
+                batch, oversized, overbudget = self._assemble_locked()
                 self._inflight += 1
                 self._cv.notify_all()   # admission slots freed
             try:
-                self._run_dispatch(batch, oversized, now)
+                self._run_dispatch(batch, oversized, overbudget, now)
             except BaseException:  # noqa: BLE001 - the loop must survive
                 self.stats.scheduler_errors += 1
                 import traceback
@@ -390,9 +435,11 @@ class ServeEngine:
                     self._inflight -= 1
                     self._cv.notify_all()
 
-    def _assemble_locked(self) -> tuple[list[_Request], list[_Request]]:
-        """Pop the next micro-batch (and any oversized requests seen while
-        scanning) off the queue. Called under the lock."""
+    def _assemble_locked(self):
+        """Pop the next micro-batch (plus any oversized requests seen
+        while scanning, and a head whose solo HBM estimate is over
+        budget — failed by the dispatcher, never run). Called under the
+        lock; returns ``(batch, oversized, overbudget)``."""
         window: list[_Request] = []
         limit = max(self.window, self.max_batch)
         while self._pending and len(window) < limit:
@@ -405,25 +452,41 @@ class ServeEngine:
             else:
                 normal.append(r)
         batch: list[_Request] = []
+        overbudget: list[_Request] = []
         if normal:
             plan = plan_batch([r.n_atoms for r in normal],
                               policy=getattr(self.potential, "caps", None),
-                              max_batch=self.max_batch, window=limit)
+                              max_batch=self.max_batch, window=limit,
+                              bytes_budget=self._hbm_budget())
             chosen = set(plan.take)
             for i, r in enumerate(normal):
                 if i in chosen:
-                    batch.append(r)
+                    # a head flagged over_budget was admitted BEFORE the
+                    # bytes model calibrated (the admission race); it can
+                    # never fit a batch — fail it instead of dispatching
+                    # an over-budget program
+                    (overbudget if plan.over_budget else batch).append(r)
                 else:
                     # not picked this round (occupancy rule / slot budget):
                     # keep its queue position for the next batch
                     heapq.heappush(self._pending, r)
-        return batch, oversized
+        return batch, oversized, overbudget
 
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
 
-    def _run_dispatch(self, batch, oversized, t_dispatch) -> None:
+    def _run_dispatch(self, batch, oversized, overbudget, t_dispatch) -> None:
+        for req in overbudget:
+            # outside the lock: failing a Future runs its done-callbacks.
+            # Accounting: this request WAS accepted (it predates the bytes
+            # model), so it counts as a failure via _fail — NOT as a
+            # submit-time reject (which would double-count it)
+            for r in self._start_requests([req]):
+                self._fail(r, ServeRejected(
+                    f"structure of {r.n_atoms} atoms is estimated over the "
+                    f"batched lane's HBM budget (admitted before the bytes "
+                    f"model calibrated); partition it spatially instead"))
         for req in oversized:
             self._run_fallback(req, t_dispatch)
         if batch:
@@ -627,7 +690,8 @@ class ServeEngine:
                   "rebuild_on_device", "rebuild_overflow_count",
                   "num_partitions", "n_cap", "e_cap",
                   "mesh_shape", "spatial_parts", "batch_parts",
-                  "halo_send_per_part", "kernel_mode", "kernel_coverage"):
+                  "halo_send_per_part", "kernel_mode", "kernel_coverage",
+                  "est_peak_bytes", "hbm_headroom_frac"):
             if pot_stats and k in pot_stats:
                 setattr(rec, k, pot_stats[k])
         tel.emit(rec)

@@ -36,6 +36,16 @@ class BatchPlan:
     skipped: list[int] = field(default_factory=list)
     total_atoms: int = 0
     node_cap: int = 0
+    est_bytes: int | None = None   # planner's estimate for the chosen batch
+    # the HEAD request ALONE is over the bytes budget on its own
+    # MEASURED rung: the plan is head-only and must NOT be dispatched —
+    # the engine fails the request instead (this closes the
+    # pre-calibration admission race: a request admitted before the
+    # bytes model existed can become an over-budget head later). A head
+    # over budget on an EXTRAPOLATED estimate is also head-only but NOT
+    # flagged: it dispatches as a solo probe whose compile calibrates
+    # the rung with the truth.
+    over_budget: bool = False
 
     @property
     def occupancy(self) -> float:
@@ -47,16 +57,18 @@ def plan_batch(
     policy: BucketPolicy | None = None,
     max_batch: int = 8,
     window: int = 64,
+    bytes_budget: int | None = None,
 ) -> BatchPlan:
     """Greedy bucket-aware micro-batch selection.
 
     ``sizes``: per-request atom counts in dispatch (priority/deadline)
     order. The head request is always taken — the max-wait timer already
     decided a batch must go out, so the oldest/most-urgent request is
-    never starved by the occupancy rule. Subsequent requests (scanned up
-    to ``window`` deep) are admitted while the batch stays under
-    ``max_batch`` slots and the admission keeps rung occupancy
-    nondecreasing:
+    never starved by the occupancy rule (a head request too big for the
+    BYTES budget never reaches the planner: engine admission rejects it
+    at submit). Subsequent requests (scanned up to ``window`` deep) are
+    admitted while the batch stays under ``max_batch`` slots and the
+    admission keeps rung occupancy nondecreasing:
 
     - same node-capacity rung: always admit (occupancy strictly rises);
     - next rung: admit if ``new_total/new_cap >= total/cap`` (climbing
@@ -73,20 +85,58 @@ def plan_batch(
     batch, so a huge request mixed into a small-request stream waits at
     most until it reaches the queue head — then it is the seed and gets
     its own appropriately-sized rung.
+
+    ``bytes_budget`` (memory-aware autobatching): the per-device HBM
+    budget in bytes. Every admission is additionally checked against the
+    policy's calibrated bytes model
+    (``BucketPolicy.estimate_batch_bytes``) — a candidate whose admission
+    would push the batch estimate past the budget is skipped, whatever
+    the slot/occupancy rules say, so the planner NEVER assembles a
+    multi-request batch estimated over budget. A HEAD whose solo
+    estimate already exceeds the budget yields a head-only plan flagged
+    ``over_budget=True`` — the caller must fail that request, not
+    dispatch it (engine admission normally rejects such requests at
+    submit, but a request admitted BEFORE the model calibrated can
+    become an over-budget head later). Until the model has any
+    calibration the check is a no-op — the first batch through a fresh
+    engine calibrates it.
     """
     policy = policy or BucketPolicy()
     plan = BatchPlan()
     if not len(sizes):
         return plan
+    est = getattr(policy, "estimate_batch_bytes", None)
+    if bytes_budget is None:
+        est = None
     total = int(sizes[0])
     cap = policy.get("nodes", total)
     plan.take.append(0)
+    if est is not None:
+        e0 = est(total)
+        if e0 is not None and e0 > bytes_budget:
+            plan.total_atoms, plan.node_cap = total, cap
+            plan.est_bytes = e0
+            # head-only either way, but only a MEASURED rung justifies
+            # failing the request: an extrapolated guess ships as a solo
+            # probe — its compile calibrates the rung with the truth
+            # (rejecting on guesses would livelock the lane: see
+            # BucketPolicy.has_calibrated_rung)
+            exact = getattr(policy, "has_calibrated_rung", None)
+            plan.over_budget = bool(exact and exact(total))
+            return plan
     for i in range(1, min(len(sizes), window)):
         n = len(plan.take)
         if n >= max_batch:
             break
         new_total = total + int(sizes[i])
         new_cap = policy.get("nodes", new_total)
+        if est is not None:
+            e = est(new_total)
+            if e is not None and e > bytes_budget:
+                # admitting this request would blow the HBM budget — the
+                # slot/occupancy rules never override the bytes gate
+                plan.skipped.append(i)
+                continue
         rung_ok = new_cap == cap or new_total * cap >= total * new_cap
         at_slot_boundary = n & (n - 1) == 0   # 1, 2, 4, 8, ...
         if rung_ok or not at_slot_boundary:
@@ -96,4 +146,6 @@ def plan_batch(
             plan.skipped.append(i)
     plan.total_atoms = total
     plan.node_cap = cap
+    if est is not None:
+        plan.est_bytes = est(total)
     return plan

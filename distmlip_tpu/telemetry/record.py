@@ -113,6 +113,15 @@ class StepRecord:
     compile_cache_size: int = 0      # jit executable cache entries after step
     compiled: bool = False           # this step triggered an XLA compile
 
+    # --- static HBM plan (analysis/memory.py; 0 = no estimate observed) ---
+    # estimated per-device peak live bytes of the step's traced program
+    # (BucketPolicy-calibrated on the batched engine; compared against
+    # measured bytes_in_use by the report's hbm_estimator_drift check)
+    est_peak_bytes: int = 0
+    # 1 - est_peak_bytes / bytes_limit against the worst device's limit
+    # (or the configured budget); 0.0 = unknown (no estimate or no limit)
+    hbm_headroom_frac: float = 0.0
+
     # --- device memory (bytes; empty where the backend reports nothing) ---
     device_memory: dict[str, int] = field(default_factory=dict)
 

@@ -22,7 +22,17 @@ and flags the anomaly classes this repo has actually hit:
   reported) whose traced programs mostly took the pure-XLA
   edge-aggregation path instead of the fused Pallas kernels
   (kernels/dispatch): the kill switch or per-object ``kernels=False``
-  is likely left on.
+  is likely left on;
+- **hbm estimator drift** — flagged ONLY when measured device stats
+  exist AND only on the sound side: the static HBM planner's
+  ``est_peak_bytes`` exceeds 4x the backend's measured peak residency
+  (the high-water mark bounds every true program peak from above, so
+  a low ratio on a mixed run proves nothing) — retune the planner
+  (analysis/memory.py) before trusting its admission gates.
+
+Device-memory occupancy renders through the SAME worst-device fraction
+the prefetch guard uses (``utils.memory.hbm_usage_frac``) — one parse,
+no per-key duplication.
 """
 
 from __future__ import annotations
@@ -151,6 +161,20 @@ class Report:
                 f"  rejects={s['rejects']} "
                 f"deadline_misses={s['deadline_misses']} "
                 f"fallback_batches={s['fallback_batches']}")
+        if ("max_hbm_used_frac" in c or "max_est_peak_bytes" in c):
+            bits = []
+            if "max_hbm_used_frac" in c:
+                bits.append(f"used worst={c['max_hbm_used_frac']:.0%}")
+            if "max_est_peak_bytes" in c:
+                bits.append(
+                    f"est_peak={c['max_est_peak_bytes'] / 2**20:.1f}MiB")
+            if "min_hbm_headroom_frac" in c:
+                bits.append(
+                    f"headroom min={c['min_hbm_headroom_frac']:.0%}")
+            if "hbm_estimator_ratio" in c:
+                bits.append(
+                    f"est/measured={c['hbm_estimator_ratio']:.2f}x")
+            out.append("hbm: " + " ".join(bits))
         if c.get("prefetch_skipped_hbm"):
             out.append(f"prefetch skipped by HBM guard: "
                        f"{c['prefetch_skipped_hbm']} step(s)")
@@ -262,6 +286,47 @@ def aggregate(
         c["max_mfu"] = max(mfus)
     c["prefetch_skipped_hbm"] = sum(
         getattr(r, "prefetch_skipped_hbm", False) for r in records)
+    # device memory + static HBM plan: occupancy through the SAME
+    # worst-device fraction the prefetch guard uses (utils/memory), the
+    # planner's peak estimates, and prediction-vs-measured drift. The
+    # drift check requires MEASURED stats — a CPU run (no device_memory)
+    # must never flag the estimator against a measurement that isn't there
+    from ..utils.memory import hbm_usage_frac, measured_peak_bytes
+
+    used = [hbm_usage_frac(r.device_memory) for r in records
+            if r.device_memory]
+    used = [u for u in used if u is not None]
+    if used:
+        c["max_hbm_used_frac"] = max(used)
+    ests = [r.est_peak_bytes for r in records if r.est_peak_bytes > 0]
+    if ests:
+        c["max_est_peak_bytes"] = max(ests)
+        heads = [r.hbm_headroom_frac for r in records
+                 if r.est_peak_bytes > 0 and r.hbm_headroom_frac != 0.0]
+        if heads:
+            c["min_hbm_headroom_frac"] = min(heads)
+        ratios = []
+        for r in records:
+            if r.est_peak_bytes <= 0 or not r.device_memory:
+                continue
+            measured = measured_peak_bytes(r.device_memory)
+            if measured:
+                ratios.append(r.est_peak_bytes / measured)
+        if ratios:
+            ratio = sum(ratios) / len(ratios)
+            c["hbm_estimator_ratio"] = ratio
+            # one-sided by design: the backend's peak_bytes_in_use is a
+            # process-lifetime high-water mark (>= any true program
+            # peak), so est >> measured is a sound over-estimation
+            # signal while est << measured merely means an earlier phase
+            # allocated more — never flag the low side
+            if ratio > 4.0:
+                rep.anomalies.append(Anomaly(
+                    "hbm_estimator_drift", 0,
+                    f"static HBM plan estimates {ratio:.2f}x the measured "
+                    f"peak residency over {len(ratios)} step(s) (> 4x) — "
+                    f"the planner's admission gates over-reject for this "
+                    f"workload (analysis/memory.py)"))
     # neighbor rebuilds: legacy records (pre-device-rebuild writers) carry
     # rebuild_count == 0 even on rebuild steps — fall back to the bool
     reb_total = sum(max(r.rebuild_count, int(r.rebuild)) for r in records)

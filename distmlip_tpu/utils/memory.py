@@ -1,0 +1,86 @@
+"""Shared device-memory statistics.
+
+ONE implementation of the backend memory-stat parsing used by the
+calculator's prefetch HBM guard, the batched engine's headroom telemetry,
+the telemetry report's device-memory rendering and the static HBM planner
+(``analysis/memory.py`` consumers) — historically two private helpers on
+``calculators/calculator.py``, deduplicated here so every consumer agrees
+on what "worst-device occupancy" means.
+
+CPU backends report no stats: every function degrades to ``{}``/``None``
+(telemetry must never fail a step)."""
+
+from __future__ import annotations
+
+
+def device_memory_stats() -> dict:
+    """Per-device ``bytes_in_use`` (and ``peak_bytes_in_use``/``bytes_limit``
+    where reported) from backends that expose memory stats (TPU/GPU; CPU
+    returns {}). Keys are ``dev<i>_bytes_in_use``-style."""
+    import jax
+
+    out = {}
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats and "bytes_in_use" in stats:
+                out[f"dev{d.id}_bytes_in_use"] = int(stats["bytes_in_use"])
+                if "peak_bytes_in_use" in stats:
+                    out[f"dev{d.id}_peak_bytes_in_use"] = int(
+                        stats["peak_bytes_in_use"])
+                if "bytes_limit" in stats:
+                    out[f"dev{d.id}_bytes_limit"] = int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 - telemetry must never fail a step
+        return {}
+    return out
+
+
+def hbm_usage_frac(stats: dict | None = None) -> float | None:
+    """Worst-device ``bytes_in_use / bytes_limit``, or None when the
+    backend reports no limits (CPU). ``stats`` lets callers reuse one
+    snapshot (and the report parse recorded ``device_memory`` dicts)."""
+    stats = device_memory_stats() if stats is None else stats
+    worst = None
+    for k, used in stats.items():
+        if not k.endswith("_bytes_in_use") or "peak" in k:
+            continue
+        limit = stats.get(k.replace("_bytes_in_use", "_bytes_limit"), 0)
+        if limit > 0:
+            frac = used / limit
+            worst = frac if worst is None else max(worst, frac)
+    return worst
+
+
+def measured_peak_bytes(stats: dict | None = None) -> int | None:
+    """Worst-device measured peak residency: ``peak_bytes_in_use`` where
+    the backend reports it, else current ``bytes_in_use``. None when no
+    device reports either (CPU). What the static planner's
+    ``est_peak_bytes`` is compared against for estimator-drift checks.
+
+    Caveat: ``peak_bytes_in_use`` is a PROCESS-LIFETIME high-water mark,
+    not the last program's peak — on a mixed run it may reflect an
+    earlier, larger phase. Drift checks therefore only trust the ratio
+    in the direction the mark bounds: measured >= any true program peak,
+    so est >> measured is a sound over-estimation signal while
+    est << measured is inconclusive."""
+    stats = device_memory_stats() if stats is None else stats
+    peaks = [v for k, v in stats.items()
+             if k.endswith("_peak_bytes_in_use")]
+    if peaks:
+        return max(peaks)
+    used = [v for k, v in stats.items()
+            if k.endswith("_bytes_in_use") and "peak" not in k]
+    return max(used) if used else None
+
+
+def device_bytes_limit(stats: dict | None = None) -> int | None:
+    """Smallest per-device ``bytes_limit`` (the binding constraint on a
+    homogeneous mesh), or None when no device reports one (CPU). The HBM
+    budget every memory-aware consumer plans against."""
+    stats = device_memory_stats() if stats is None else stats
+    limits = [v for k, v in stats.items() if k.endswith("_bytes_limit")]
+    return min(limits) if limits else None
+
+
+__all__ = ["device_memory_stats", "hbm_usage_frac", "device_bytes_limit",
+           "measured_peak_bytes"]

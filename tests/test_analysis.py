@@ -533,11 +533,13 @@ def test_contract_audit_survives_broken_pass(rng, monkeypatch):
         raise RuntimeError("pass exploded")
 
     monkeypatch.setattr(analysis, "run_passes", boom)
-    n, errs, warns, kmode, kcov = pot._contract_audit()
+    n, errs, warns, kmode, kcov, est = pot._contract_audit()
     assert n > 0, "collective tally must survive a broken pass"
     assert (errs, warns) == (0, 0)
     # the kernel-dispatch tally rides the same trace and must survive too
     assert kmode in ("pallas", "xla") and 0.0 <= kcov <= 1.0
+    # ...and so does the static HBM plan (computed before the passes run)
+    assert est > 0
 
 
 @pytest.mark.tier1

@@ -3,7 +3,8 @@
 
     python tools/contract_check.py [--models chgnet,tensornet,mace,escn]
         [--programs SUBSTR] [--passes p1,p2] [--kernels {auto,on,off}]
-        [--lint] [--only-lint] [--list-passes] [--json] [--verbose]
+        [--hbm-budget-gb G] [--lint] [--only-lint] [--list-passes]
+        [--json] [--verbose]
 
 Builds small test systems, traces the REAL programs the runtime ships —
 for every model the forward total-energy and value_and_grad potential at
@@ -25,6 +26,12 @@ recurses into them; no chip or compile needed). ``off`` forces the
 pure-XLA fallback; ``auto`` (default) leaves the env/backend routing
 alone. CI runs both: the contracts must hold on BOTH sides of the
 dispatch.
+
+``--hbm-budget-gb G`` states the per-device HBM budget for the
+``memory_budget`` pass explicitly (GiB). Without it the pass uses the
+backend-reported ``bytes_limit`` — absent on this CPU entry point, so the
+pass reports its peak estimate as INFO and gates nothing; with a budget,
+a program whose estimated peak exceeds 90% of it is an ERROR (exit 3).
 
 ``--lint`` additionally runs the repo-specific AST lint
 (:mod:`distmlip_tpu.analysis.lint`) over the package + tools, and chains
@@ -317,6 +324,10 @@ def main(argv=None) -> int:
                     choices=("auto", "on", "off"),
                     help="trace with Pallas fused kernels forced on/off "
                          "(auto: env/backend routing)")
+    ap.add_argument("--hbm-budget-gb", type=float, default=None,
+                    help="per-device HBM budget (GiB) for the "
+                         "memory_budget pass (default: backend-reported "
+                         "bytes_limit; none on CPU)")
     ap.add_argument("--lint", action="store_true",
                     help="also run the AST lint (+ruff when installed)")
     ap.add_argument("--only-lint", action="store_true",
@@ -387,6 +398,10 @@ def main(argv=None) -> int:
                 _trace_packed_batch(programs)
             if want("device_md[pair][1x1]"):
                 _trace_device_md(programs)
+        if args.hbm_budget_gb is not None:
+            for prog in programs:
+                prog.config.setdefault(
+                    "bytes_limit", int(args.hbm_budget_gb * 2**30))
         for prog in programs:
             findings = run_passes(prog, passes)
             all_findings.extend(findings)

@@ -20,8 +20,12 @@ Exit codes: 0 ok, 3 check failed, 2 usage.
 
 ``--contracts`` additionally traces the engine's batched potential (the
 exact program the scheduler dispatches) and runs every registered
-``distmlip_tpu.analysis`` contract pass over the jaxpr; combined with
-``--check``, an error-severity finding fails the gate.
+``distmlip_tpu.analysis`` contract pass over the jaxpr — including
+``memory_budget``: the serving program's statically estimated peak must
+fit the HBM budget (``--hbm-budget-gb``, default: the backend-reported
+limit; no gate when neither exists). Combined with ``--check``, an
+error-severity finding fails the gate and the summary carries
+``est_peak_bytes`` for the estimator-drift trajectory.
 
 Smoke (verify flow): ``python tools/load_test.py --requests 12 --check``
 (~seconds on CPU with the default pair model).
@@ -95,7 +99,10 @@ def run(args) -> int:
     telemetry = None
     if args.jsonl:
         telemetry = Telemetry([JsonlSink(args.jsonl)])
-    pot = BatchedPotential(model, params, caps=caps, skin=args.skin)
+    budget_bytes = (int(args.hbm_budget_gb * 2**30)
+                    if args.hbm_budget_gb else None)
+    pot = BatchedPotential(model, params, caps=caps, skin=args.skin,
+                           hbm_budget_bytes=budget_bytes)
     engine = ServeEngine(
         pot, max_batch=args.max_batch, max_wait_s=args.max_wait,
         max_queue=args.max_queue, admission=args.admission,
@@ -171,13 +178,14 @@ def run(args) -> int:
         summary["jsonl"] = args.jsonl
 
     contract_errors = None
+    est_peak = None
     if args.contracts:
         # static contract audit of the SERVING program: trace the same
         # batched potential the engine dispatches through over a
         # representative packed pool batch and run every registered
         # analysis pass (distmlip_tpu.analysis) — the scheduler must never
         # ship a program that breaks the collective/host-sync/dtype/
-        # scatter-hint contracts
+        # scatter-hint/memory-budget contracts
         import jax
 
         from distmlip_tpu.analysis import Program, error_count, run_passes
@@ -189,14 +197,20 @@ def run(args) -> int:
             sgraph = pot._build(pool[:min(len(pool), args.max_batch)])[0]
         jaxpr = jax.make_jaxpr(pot._potential)(
             params, sgraph, sgraph.positions)
+        cfg = {"max_total_collectives": 0}
+        if budget_bytes is not None:
+            cfg["bytes_limit"] = budget_bytes
         findings = run_passes(Program(
             name="serving_program", jaxpr=jaxpr,
-            tags=frozenset({"grad"}),
-            config={"max_total_collectives": 0}))
+            tags=frozenset({"grad"}), config=cfg))
         contract_errors = error_count(findings)
+        # the memory_budget pass cached its plan on the config — one walk
+        plan = cfg.get("_memory_plan")
+        est_peak = plan.peak_bytes if plan is not None else 0
         summary["contract_errors"] = contract_errors
         summary["contract_findings"] = [
             f.render() for f in findings if not f.suppressed][:20]
+        summary["est_peak_bytes"] = est_peak
 
     if args.check:
         # BucketPolicy compile bound: node/edge rungs over the pool's size
@@ -217,7 +231,11 @@ def run(args) -> int:
             "drained_clean": bool(drained) and depth_after_drain == 0,
         }
         if contract_errors is not None:
+            # contracts include memory_budget: the serving program's
+            # estimated peak fits the configured/reported HBM budget
+            # (no budget known -> the pass only reports, never errors)
             checks["contracts"] = contract_errors == 0
+            checks["memory_planned"] = bool(est_peak and est_peak > 0)
         summary["checks"] = checks
         summary["compile_bound"] = bound
         if not all(checks.values()):
@@ -258,6 +276,11 @@ def main(argv=None) -> int:
                         "with --check, any error-severity finding fails "
                         "the gate")
     p.add_argument("--occupancy-floor", type=float, default=0.95)
+    p.add_argument("--hbm-budget-gb", type=float, default=None,
+                   help="per-device HBM budget for the batched lane "
+                        "(memory-aware autobatching + the --contracts "
+                        "memory_budget gate); default: backend-reported "
+                        "bytes_limit (none on CPU)")
     args = p.parse_args(argv)
     return run(args)
 

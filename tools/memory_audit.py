@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Static HBM audit: per-program peak-memory table from the planner.
+
+    python tools/memory_audit.py [--models chgnet,tensornet,mace,escn]
+        [--programs SUBSTR] [--kernels {auto,on,off}] [--budget-gb G]
+        [--frac 0.9] [--oracle] [--top K] [--json]
+
+Traces the SAME real program family ``tools/contract_check.py`` gates
+(forward energy + value_and_grad potential at (1,1)/(2,1)/(2,2), the
+packed batch, the DeviceMD chunk stepper) and prints, per program, the
+static HBM planner's estimate (:mod:`distmlip_tpu.analysis.memory`):
+per-device peak live bytes, its composition (args/consts/temps), the
+top live-set contributors with their trace sites, and the largest
+transient windows. No chip, no compile — abstract tracing on CPU.
+
+``--oracle`` additionally COMPILES each program (CPU XLA — slow) and
+prints the estimate/oracle ratio against
+``lower().compile().memory_analysis()`` totals, the estimator's
+calibration oracle (the tier-1 band is [0.5, 2.0] —
+tests/test_memory_plan.py pins it).
+
+``--budget-gb G`` gates: any program whose estimated peak exceeds
+``--frac`` (default 0.9) of the budget is a violation — same semantics
+as the registered ``memory_budget`` contract pass, same exit code
+convention as halo_audit.
+
+Exit codes: 0 ok, 2 usage error, 3 budget violation.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+# multi-device CPU mesh, set before jax initializes (same trick as tests)
+_flag = "--xla_force_host_platform_device_count=8"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+
+def main(argv=None) -> int:
+    import contract_check as cc
+
+    ap = argparse.ArgumentParser(
+        prog="memory_audit", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--models", default=",".join(cc.ALL_MODELS))
+    ap.add_argument("--programs", default=None,
+                    help="only audit programs whose name contains SUBSTR")
+    ap.add_argument("--kernels", default="auto",
+                    choices=("auto", "on", "off"))
+    ap.add_argument("--budget-gb", type=float, default=None,
+                    help="per-device HBM budget (GiB); estimates above "
+                         "--frac of it violate (exit 3)")
+    ap.add_argument("--frac", type=float, default=0.9,
+                    help="budget fraction that counts as a violation")
+    ap.add_argument("--oracle", action="store_true",
+                    help="also compile each program and report the "
+                         "estimate/XLA-memory_analysis ratio (slow)")
+    ap.add_argument("--top", type=int, default=4,
+                    help="contributors/transients to print per program")
+    ap.add_argument("--json", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+        models = tuple(m.strip() for m in args.models.split(",") if m.strip())
+        bad = [m for m in models if m not in cc.ALL_MODELS]
+        if bad:
+            raise ValueError(f"unknown model(s) {bad}; pick from "
+                             f"{list(cc.ALL_MODELS)}")
+        if args.budget_gb is not None and args.budget_gb <= 0:
+            raise ValueError("--budget-gb must be > 0")
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+    except ValueError as e:
+        print(f"usage error: {e}", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distmlip_tpu.analysis.memory import analyze_memory, oracle_peak_bytes
+    from distmlip_tpu.kernels import force_kernel_mode
+
+    forced = {"auto": None, "on": "pallas", "off": "xla"}[args.kernels]
+    want = (cc._want_all if not args.programs
+            else (lambda n: args.programs in n))
+    programs = []
+    with force_kernel_mode(forced):
+        for name in models:
+            cc._trace_model_programs(name, programs, want)
+        if want("packed_batch[tensornet][B=4]"):
+            cc._trace_packed_batch(programs)
+        if want("device_md[pair][1x1]"):
+            cc._trace_device_md(programs)
+
+    budget = (int(args.budget_gb * 2**30)
+              if args.budget_gb is not None else None)
+    report = {"kernels": args.kernels, "budget_bytes": budget,
+              "programs": {}}
+    violations = 0
+    for prog in programs:
+        plan = analyze_memory(prog.jaxpr, top_k=max(args.top, 1))
+        if args.oracle:
+            plan.oracle_bytes = oracle_peak_bytes(prog.jaxpr)
+        entry = {
+            "peak_bytes": plan.peak_bytes,
+            "arg_bytes": plan.arg_bytes,
+            "const_bytes": plan.const_bytes,
+            "temp_peak_bytes": plan.temp_peak_bytes,
+            "n_eqns": plan.n_eqns,
+            "contributors": [c.render().strip()
+                             for c in plan.contributors[:args.top]],
+            "transients": [t.render().strip()
+                           for t in plan.transients[:args.top]],
+        }
+        if plan.oracle_bytes:
+            entry["oracle_bytes"] = plan.oracle_bytes
+            entry["est_over_oracle"] = plan.peak_bytes / plan.oracle_bytes
+        over = (budget is not None
+                and plan.peak_bytes > args.frac * budget)
+        entry["over_budget"] = bool(over)
+        violations += int(over)
+        report["programs"][prog.name] = entry
+        if not args.json:
+            flag = "  <-- OVER BUDGET" if over else ""
+            ratio = (f"  est/oracle={entry['est_over_oracle']:.2f}x"
+                     if "oracle_bytes" in entry else "")
+            print(f"{prog.name:<34} peak {plan.peak_bytes / 2**20:8.2f} MiB"
+                  f" (args {plan.arg_bytes / 2**20:.2f} + consts "
+                  f"{plan.const_bytes / 2**20:.2f} + temps "
+                  f"{plan.temp_peak_bytes / 2**20:.2f}){ratio}{flag}")
+            for c in plan.contributors[:args.top]:
+                print("    " + c.render())
+            for t in plan.transients[:args.top]:
+                print("    " + t.render())
+
+    report["violations"] = violations
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        worst = max((e["peak_bytes"] for e in report["programs"].values()),
+                    default=0)
+        line = (f"memory audit: {len(report['programs'])} program(s), "
+                f"worst peak {worst / 2**20:.2f} MiB")
+        if budget is not None:
+            line += (f", budget {budget / 2**30:.2f} GiB "
+                     f"-> {violations} violation(s)")
+        print(line)
+    return 3 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -605,6 +605,62 @@ def _main_measured():
         except Exception as e:  # noqa: BLE001 - mesh phase is additive
             mesh_extras["mesh_error"] = f"{type(e).__name__}: {e}"[:160]
 
+    # training subsystem: examples/sec + step time through the accumulated
+    # train step (distmlip_tpu.train) at accumulation windows {1, 4} —
+    # synthetic labels (throughput, not fitting), per-step TrainRecords
+    # ride the shared telemetry sinks (JSONL artifact included), and the
+    # static HBM planner's estimate of the step program is recorded.
+    # BENCH_TRAIN=0 skips.
+    train_extras = {}
+    if os.environ.get("BENCH_TRAIN", "1") != "0":
+        t_budget = float(os.environ.get("BENCH_TRAIN_TIMEOUT_S", "900"))
+        watchdog.phase(
+            f"train-phase measurement exceeded {t_budget:.0f}s", t_budget)
+        try:
+            import optax
+
+            from distmlip_tpu.calculators import Atoms as _Atoms
+            from distmlip_tpu.train import Sample, TrainConfig, Trainer
+
+            n_struct = int(os.environ.get("BENCH_TRAIN_STRUCTURES", "8"))
+            t_steps = int(os.environ.get("BENCH_TRAIN_STEPS", "3"))
+            t_reps = int(os.environ.get("BENCH_TRAIN_REPS", "3"))
+            frac_t, lat_t = geometry.make_supercell(
+                unit, np.eye(3) * 3.9, (t_reps, t_reps, t_reps))
+            samples_t = []
+            for _ in range(n_struct):
+                cart_t = geometry.frac_to_cart(frac_t, lat_t) + \
+                    rng.normal(0, 0.04, (len(frac_t), 3))
+                samples_t.append(Sample(
+                    _Atoms(numbers=np.full(len(cart_t), 14),
+                           positions=cart_t, cell=lat_t),
+                    0.0, np.zeros((len(cart_t), 3), np.float32)))
+            train_extras["train_atoms_per_structure"] = len(frac_t)
+            for accum in (1, 4):
+                if n_struct < 2 * accum:
+                    continue
+                b_t = max(n_struct // (2 * accum), 1)
+                trainer = Trainer(
+                    model.energy_fn, pot.params, optax.adam(1e-3),
+                    samples_t, float(model.cfg.cutoff),
+                    micro_batch_size=b_t,
+                    config=TrainConfig(accum_steps=accum),
+                    hbm_budget_frac=0.95, telemetry=telemetry,
+                    loader_kwargs={"species_fn":
+                                   lambda z: np.zeros(len(z), np.int32)})
+                trainer.fit(steps=1)  # compile + warm
+                t0 = time.perf_counter()
+                trainer.fit(steps=t_steps)
+                dt_t = (time.perf_counter() - t0) / max(t_steps, 1)
+                train_extras[f"train_examples_per_sec_accum{accum}"] = \
+                    round(accum * b_t / dt_t, 2)
+                train_extras[f"train_step_s_accum{accum}"] = round(dt_t, 4)
+                train_extras["train_est_peak_mib"] = round(
+                    trainer.est_peak_bytes / 2**20, 1)
+                trainer.close()
+        except Exception as e:  # noqa: BLE001 - train phase is additive
+            train_extras["train_error"] = f"{type(e).__name__}: {e}"[:160]
+
     # device-resident MD: steps/sec through DeviceMD with the neighbor
     # rebuild ON DEVICE (in-loop cell list, zero host syncs) vs the host
     # FPIS rebuild at EQUAL skin, plus a rebuilds/sec microbench of the
@@ -737,7 +793,7 @@ def _main_measured():
     # its A/B counterpart (host-side jaxpr traces — no device work), plus
     # the analytic-FLOP mfu for the measured steps
     extras = {"halo_mode": halo_mode, **batched_extras, **serve_extras,
-              **mesh_extras, **dmd_extras, **kern_extras}
+              **mesh_extras, **train_extras, **dmd_extras, **kern_extras}
     try:
         from distmlip_tpu.parallel import make_potential_fn
         from distmlip_tpu.parallel.audit import count_collectives

@@ -64,7 +64,14 @@ class DtypeDisciplinePass(ContractPass):
                 n, first = f64_sites.get(site.primitive, (0, site))
                 f64_sites[site.primitive] = (n + 1, first)
             if (site.primitive == "scatter-add"
-                    and _aval_dtype(eqn.outvars[0]) in _HALF):
+                    and _aval_dtype(eqn.outvars[0]) in _HALF
+                    # unique-index scatter-adds (transposes of static
+                    # slices, one-hot writes) add each slot ONCE into the
+                    # operand — there is no iterated accumulation to lose
+                    # ulps in; only repeatable-index scatters (segment
+                    # sums, gather transposes) carry the fp32-accum
+                    # contract
+                    and not bool(eqn.params.get("unique_indices", False))):
                 findings.append(self.finding(
                     Severity.WARNING,
                     f"scatter-add accumulates in "

@@ -286,9 +286,20 @@ def _match_consts(raw_fwd, raw_bwd):
 
 
 def _rows_of(item):
-    """Materialize one input's per-edge rows (XLA path / backward)."""
+    """Materialize one input's per-edge rows (XLA path / backward).
+
+    Half-precision node arrays gather through an fp32 view: the gather's
+    TRANSPOSE is a scatter-add of per-edge cotangents into the node rows,
+    and routing it through fp32 accumulates those contributions at full
+    precision with one rounding at the end (the dtype_discipline
+    contract) — the forward rows are bit-identical (upcast/downcast of
+    the same values) and the convert fuses into the gather."""
     if isinstance(item, Gather):
-        return jnp.take(jnp.asarray(item.node), item.idx, axis=0)
+        node = jnp.asarray(item.node)
+        if str(node.dtype) in ("bfloat16", "float16"):
+            return jnp.take(node.astype(jnp.float32), item.idx,
+                            axis=0).astype(node.dtype)
+        return jnp.take(node, item.idx, axis=0)
     return jnp.asarray(item)
 
 
@@ -515,9 +526,27 @@ def _edge_aggregate_bwd(make_rowwise, prep, arrs, dconsts, idxs,
         ids_c, m_c, *per_edge = xs_c
         rows = []
         for p, a, col in zip(prep, arrs, per_edge):
-            rows.append(jnp.take(a, col, axis=0)
-                        if isinstance(p, Gather) else col)
-        gm = jnp.take(g, ids_c, axis=0)
+            if isinstance(p, Gather):
+                # f32-view gather for half node arrays: under SECOND-order
+                # AD (the force loss differentiates through this backward)
+                # the take's transpose scatter-adds per-edge cotangents
+                # into the node rows — same fp32-accumulation contract as
+                # _rows_of; forward rows are bit-identical
+                if str(a.dtype) in ("bfloat16", "float16"):
+                    rows.append(jnp.take(a.astype(jnp.float32), col,
+                                         axis=0).astype(a.dtype))
+                else:
+                    rows.append(jnp.take(a, col, axis=0))
+            else:
+                rows.append(col)
+        # same f32-view rule for the message-cotangent gather: its
+        # second-order transpose segment-sums per-edge rows back into the
+        # (num_segments, width) cotangent — fp32 accumulation, one round
+        if str(g.dtype) in ("bfloat16", "float16"):
+            gm = jnp.take(g.astype(jnp.float32), ids_c,
+                          axis=0).astype(g.dtype)
+        else:
+            gm = jnp.take(g, ids_c, axis=0)
         gm = gm * m_c.reshape(m_c.shape + (1,) * (gm.ndim - 1))
         if diff_params:
             msg, vjp_fn = jax.vjp(rowwise, tuple(rows), tuple(dconsts))
@@ -533,8 +562,11 @@ def _edge_aggregate_bwd(make_rowwise, prep, arrs, dconsts, idxs,
         for p, col, ct in zip(prep, per_edge, row_cts):
             if isinstance(p, Gather):
                 # contract: allow(scatter_hints) — grad-path transpose of
-                # an unsorted gather (src order is not dst order)
-                new_node_cts[gi] = new_node_cts[gi].at[col].add(ct)
+                # an unsorted gather (src order is not dst order). The
+                # accumulator carries fp32 (node_cts0 below): half inputs
+                # would otherwise round per edge AND per chunk.
+                new_node_cts[gi] = new_node_cts[gi].at[col].add(
+                    ct.astype(new_node_cts[gi].dtype))
                 gi += 1
             else:
                 plain_out.append(ct)
@@ -542,8 +574,12 @@ def _edge_aggregate_bwd(make_rowwise, prep, arrs, dconsts, idxs,
                          if diff_params else const_cts)
         return (tuple(new_node_cts), new_const_cts), tuple(plain_out)
 
+    # half-precision node arrays accumulate their cotangents in an fp32
+    # carry (rounded back to the storage dtype once, after the scan) —
+    # the dtype_discipline fp32-accumulation contract
     node_cts0 = tuple(
-        jnp.zeros(a.shape, a.dtype)
+        jnp.zeros(a.shape, jnp.float32 if str(a.dtype) in
+                  ("bfloat16", "float16") else a.dtype)
         for p, a in zip(prep, arrs) if isinstance(p, Gather))
     const_cts0 = tuple(jnp.zeros(c.shape, c.dtype) for c in dconsts)
 
@@ -560,9 +596,9 @@ def _edge_aggregate_bwd(make_rowwise, prep, arrs, dconsts, idxs,
 
     out = []
     gi = pi = 0
-    for p in prep:
+    for p, a in zip(prep, arrs):
         if isinstance(p, Gather):
-            out.append(node_cts[gi])
+            out.append(node_cts[gi].astype(a.dtype))
             gi += 1
         else:
             out.append(plain[pi])

@@ -31,8 +31,9 @@ import jax.numpy as jnp
 
 from ..kernels.dispatch import Gather
 from ..ops import radial
-from ..ops.nn import (cast_params_subtrees, embedding, layernorm,
-                      layernorm_init, linear, linear_init, mlp, mlp_init)
+from ..ops.nn import (cast_params_subtrees, embedding, gather_rows,
+                      layernorm, layernorm_init, linear, linear_init, mlp,
+                      mlp_init)
 
 
 @dataclass(frozen=True)
@@ -153,8 +154,11 @@ class TensorNet:
         S_e = (rhat[:, :, None] * rhat[:, None, :])[..., None] - eye / 3.0
 
         z = embedding(params["species_emb"], lg.species)         # (N, C)
+        # gather_rows: on the bf16 path the backward accumulates per-node
+        # feature grads from every referencing edge in fp32, not bf16
         Zij = linear(params["emb2"],
-                     jnp.concatenate([z[lg.edge_src], z[lg.edge_dst]], axis=-1))
+                     jnp.concatenate([gather_rows(z, lg.edge_src),
+                                      gather_rows(z, lg.edge_dst)], axis=-1))
         W1 = linear(params["dist_proj"][0], rbf) * env[:, None]  # (E, C)
         W2 = linear(params["dist_proj"][1], rbf) * env[:, None]
         W3 = linear(params["dist_proj"][2], rbf) * env[:, None]

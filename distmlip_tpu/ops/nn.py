@@ -136,5 +136,23 @@ def embedding_init(key, num: int, dim: int):
     return {"w": jax.random.normal(key, (num, dim)) / np.sqrt(dim)}
 
 
+def gather_rows(table, idx):
+    """Row gather whose GRADIENT accumulates in fp32.
+
+    A plain ``table[idx]`` on a half-precision table transposes to a
+    half-precision scatter-add — per-row grad contributions from every
+    referencing edge/atom round at bf16 as they accumulate (and violate
+    the dtype_discipline contract: accumulate fp32, store half). Routing
+    the gather through an fp32 view moves the scatter-add to fp32 — the
+    cotangent upcasts PER CONTRIBUTION before accumulation and rounds to
+    the storage dtype once — while the forward still hands consumers the
+    original compute dtype (the upcast fuses into the gather; rows, not
+    the table, pay the convert).
+    """
+    if table.dtype in (jnp.bfloat16, jnp.float16):
+        return table.astype(jnp.float32)[idx].astype(table.dtype)
+    return table[idx]
+
+
 def embedding(p, idx):
-    return p["w"][idx]
+    return gather_rows(p["w"], idx)

@@ -14,6 +14,25 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+_HALF_DTYPES = ("bfloat16", "float16")
+
+
+def _accum_sum(data, segment_ids, num_segments: int,
+               indices_are_sorted: bool):
+    """The one scatter-accumulation primitive: half-precision inputs
+    accumulate in fp32 and round ONCE on the way out (the dtype_discipline
+    contract — per-edge bf16 rounding inside a many-edge segment sum loses
+    ulps edge by edge), full-precision inputs accumulate as-is."""
+    dtype = data.dtype
+    if str(dtype) in _HALF_DTYPES:
+        out = jax.ops.segment_sum(
+            data.astype(jnp.float32), segment_ids,
+            num_segments=num_segments,
+            indices_are_sorted=indices_are_sorted)
+        return out.astype(dtype)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments,
+                               indices_are_sorted=indices_are_sorted)
+
 
 def masked_segment_sum(data, segment_ids, num_segments: int, mask=None,
                        indices_are_sorted: bool = False):
@@ -21,13 +40,14 @@ def masked_segment_sum(data, segment_ids, num_segments: int, mask=None,
 
     Graph edge/line arrays are emitted dst-sorted by the partition builder,
     so callers aggregating over full edge arrays pass
-    ``indices_are_sorted=True`` (TPU scatter fast path).
+    ``indices_are_sorted=True`` (TPU scatter fast path). Half-precision
+    data accumulates in fp32 (see ``_accum_sum``).
     """
     if mask is not None:
         m = mask.astype(data.dtype)
         data = data * m.reshape(m.shape + (1,) * (data.ndim - m.ndim))
-    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments,
-                               indices_are_sorted=indices_are_sorted)
+    return _accum_sum(data, segment_ids, num_segments=num_segments,
+                      indices_are_sorted=indices_are_sorted)
 
 
 def masked_segment_mean(data, segment_ids, num_segments: int, mask=None,
@@ -58,6 +78,6 @@ def masked_segment_softmax(logits, segment_ids, num_segments: int, mask=None,
     ex = jnp.exp(logits)
     if mask is not None:
         ex = jnp.where(mask, ex, 0.0)
-    denom = jax.ops.segment_sum(ex, segment_ids, num_segments=num_segments,
-                                indices_are_sorted=indices_are_sorted)
+    denom = _accum_sum(ex, segment_ids, num_segments=num_segments,
+                       indices_are_sorted=indices_are_sorted)
     return ex / jnp.maximum(denom[segment_ids], 1e-30)

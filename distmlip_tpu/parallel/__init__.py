@@ -3,8 +3,8 @@ from .mesh import (BATCH_AXIS, GRAPH_AXIS, SPATIAL_AXIS, device_mesh,
                    latency_hiding_flags, mesh_shape)
 from .halo import HALO_MODES, LocalGraph, local_graph_from_stacked
 from .runtime import (make_total_energy, make_potential_fn,
-                      make_batched_potential_fn, make_site_fn,
-                      graph_in_specs, graph_row_axes)
+                      make_batched_potential_fn, make_packed_energy_fn,
+                      make_site_fn, graph_in_specs, graph_row_axes)
 from .audit import (collective_counts, collectives_by_axis,
                     count_collectives, ppermutes_by_scope)
 
@@ -23,6 +23,7 @@ __all__ = [
     "make_total_energy",
     "make_potential_fn",
     "make_batched_potential_fn",
+    "make_packed_energy_fn",
     "make_site_fn",
     "graph_in_specs",
     "graph_row_axes",

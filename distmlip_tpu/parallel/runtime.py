@@ -287,25 +287,105 @@ def make_potential_fn(model_energy_fn, mesh: Mesh | None,
     return potential
 
 
+def make_packed_energy_fn(model_energy_fn, mesh: Mesh | None = None,
+                          diff_params: bool = True,
+                          halo_mode: str = "coalesced", kernels=None):
+    """Per-structure energies of a packed batch, params-DIFFERENTIABLE.
+
+    ``(params, graph, positions, strain) -> (B_total,)`` energies, where
+    ``graph`` is a :func:`distmlip_tpu.partition.pack_structures` pack
+    (``mesh=None`` requires the single-partition pack; a 2-D mesh accepts
+    the matching (batch x spatial) placement) and ``strain`` is the
+    per-structure ``(B_total, 3, 3)`` symmetric strain.
+
+    This is the TRAINING counterpart of
+    :func:`make_batched_potential_fn`'s internal energy program: with
+    ``diff_params=True`` (default) parameter gradients flow — the loss
+    factories in :mod:`distmlip_tpu.train.step` differentiate it twice
+    (inner positions/strain grad for forces/stress, outer params grad for
+    the update). Not jitted here: callers embed it inside their own jitted
+    step (one program per accumulation window).
+    """
+    local_energy = _local_batched_energy(model_energy_fn, aux=False,
+                                         halo_mode=halo_mode,
+                                         kernels=kernels,
+                                         diff_params=diff_params)
+
+    if mesh is None:
+        def packed_energy(params, graph, positions, strain):
+            if graph.num_partitions != 1 or graph.batch_size < 1:
+                raise ValueError(
+                    "make_packed_energy_fn(mesh=None) requires a "
+                    f"single-partition packed graph (got "
+                    f"P={graph.num_partitions}, "
+                    f"batch_size={graph.batch_size}); build it with "
+                    "pack_structures(), or pass the 2-D mesh the graph "
+                    "was packed for.")
+            return local_energy(params, strain, graph, positions)[0]
+        return packed_energy
+
+    missing = [ax for ax in (BATCH_AXIS, SPATIAL_AXIS)
+               if ax not in mesh.axis_names]
+    if missing:
+        raise ValueError(
+            f"make_packed_energy_fn needs a mesh with named axes "
+            f"({BATCH_AXIS!r}, {SPATIAL_AXIS!r}); this mesh "
+            f"{tuple(mesh.axis_names)} lacks {missing} — build it with "
+            f"parallel.device_mesh(batch, spatial).")
+    mesh_bp, mesh_sp = mesh_shape(mesh)
+
+    def packed_energy(params, graph, positions, strain):
+        if graph.batch_size < 1 or graph.struct_id is None:
+            raise ValueError(
+                "make_packed_energy_fn requires a packed graph "
+                "(batch_size >= 1); build it with pack_structures().")
+        if graph.batch_parts != mesh_bp or graph.spatial_size != mesh_sp:
+            raise ValueError(
+                f"graph placement {graph.batch_parts}x{graph.spatial_size} "
+                f"does not match the {mesh_bp}x{mesh_sp} mesh; pack with "
+                f"batch_parts={mesh_bp}, spatial_parts={mesh_sp}.")
+        axes = mesh_row_axes(mesh)
+        row = P(axes)
+
+        def local_e(params, strain, graph_local, positions):
+            return local_energy(params, strain, graph_local, positions)[0]
+
+        sharded = shard_map(
+            local_e, mesh=mesh,
+            in_specs=(P(), P(BATCH_AXIS), graph_in_specs(graph, axes), row),
+            out_specs=P(BATCH_AXIS), **_NO_CHECK)
+        return sharded(params, strain, graph, positions)
+
+    return packed_energy
+
+
 def _local_batched_energy(model_energy_fn, aux, halo_mode="coalesced",
-                          kernels=None):
+                          kernels=None, diff_params=False):
     """Shard-local batched energy: strain -> halo exchange -> model ->
     per-structure readout. Shared by the single-device packed path and the
     2-D mesh path (where it runs inside shard_map with the spatial axis
-    bound)."""
+    bound).
+
+    ``diff_params=False`` (the batched INFERENCE engine) stop-gradients the
+    params — grads are positions/strain only, and the stop keeps the fused
+    kernels' custom VJPs free of weight-cotangent compute and mesh psums
+    (see make_total_energy). The training path passes True so loss
+    gradients flow into the model weights through the same packed program
+    (train/step.py)."""
 
     def local_energy(params, strain, graph_local, positions):
         # graph_local: per-shard (1, ...) slices (or the whole P=1 graph on
         # the meshless path); strain: (B_local, 3, 3) — this batch shard's
         # slots only
         axis = SPATIAL_AXIS if graph_local.spatial_size > 1 else None
-        # batched inference engine: grads are positions/strain only — cut
-        # param-bound kernel-VJP cotangents before the mesh boundary (see
-        # make_total_energy)
-        params = jax.lax.stop_gradient(params)
+        if not diff_params:
+            # batched inference engine: grads are positions/strain only —
+            # cut param-bound kernel-VJP cotangents before the mesh
+            # boundary (see make_total_energy)
+            params = jax.lax.stop_gradient(params)
         lg, _ = local_graph_from_stacked(graph_local, axis, halo_mode,
                                          kernels=kernels,
-                                         kernels_diff_params=False)
+                                         kernels_diff_params=diff_params)
         B = graph_local.batch_size
         dtype = positions.dtype
         pos = positions[0]

@@ -3,7 +3,8 @@ from .partitioner import build_block_plan, build_plan, PartitionError
 from .graph import (PartitionedGraph, HostGraphData, build_partitioned_graph,
                     device_refresh_graph, expand_shift_tables, refresh_edges)
 from .capacity import (BucketPolicy, CapacityPolicy, FixedCaps,
-                       geometric_bucket, round_capacity)
+                       fixed_caps_for_batches, geometric_bucket,
+                       round_capacity)
 from .batch import (MeshPackedHostData, PackedHostData, bucket_key,
                     build_packed_refresh_spec, device_refresh_packed,
                     pack_structures, pack_structures_mesh, packed_stats)
@@ -21,6 +22,7 @@ __all__ = [
     "CapacityPolicy",
     "BucketPolicy",
     "FixedCaps",
+    "fixed_caps_for_batches",
     "geometric_bucket",
     "round_capacity",
     "expand_shift_tables",

@@ -99,10 +99,28 @@ class PackedHostData:
 
     def scatter_positions(self, positions_list, dtype=np.float32) -> np.ndarray:
         """Pack per-structure (n_b, 3) position arrays into (1, N_cap, 3)."""
-        out = np.zeros((1, self.n_cap, 3), dtype=dtype)
-        for b, pos in enumerate(positions_list):
+        return self.scatter_per_atom(positions_list, dtype=dtype)
+
+    def scatter_per_atom(self, arrays, dtype=np.float32) -> np.ndarray:
+        """Pack per-structure per-atom arrays (n_b, ...) of a shared
+        trailing shape into the graph's padded (1, N_cap, ...) layout
+        (padded rows zero). Positions, force targets, per-atom labels —
+        anything node-aligned packs through here."""
+        trail = np.shape(np.asarray(arrays[0]))[1:]
+        out = np.zeros((1, self.n_cap) + trail, dtype=dtype)
+        for b, arr in enumerate(arrays):
             s = self.node_offsets[b]
-            out[0, s:s + len(pos)] = pos
+            out[0, s:s + len(arr)] = arr
+        return out
+
+    def atom_slots(self) -> np.ndarray:
+        """(1, N_cap) int32 flat energy-slot of each node row; padded rows
+        carry the ``batch_slots`` sentinel (one past the last slot) so a
+        slot-indexed gather can be masked/clamped uniformly. Aligns
+        per-atom arrays with the runtime's flat ``energies`` output."""
+        out = np.full((1, self.n_cap), self.batch_size, dtype=np.int32)
+        for b in range(self.num_structures):
+            out[0, self.node_offsets[b]:self.node_offsets[b + 1]] = b
         return out
 
     def gather_per_structure(self, packed: np.ndarray) -> list:
@@ -598,12 +616,35 @@ class MeshPackedHostData:
     def scatter_positions(self, positions_list, dtype=np.float32) -> np.ndarray:
         """Pack per-structure (n_b, 3) positions into (P, N_cap, 3) owned
         rows (halo rows are refreshed in-jit by the spatial exchange)."""
+        return self.scatter_per_atom(positions_list, dtype=dtype)
+
+    def scatter_per_atom(self, arrays, dtype=np.float32) -> np.ndarray:
+        """Pack per-structure per-atom arrays (n_b, ...) of a shared
+        trailing shape into owned rows of the (P, N_cap, ...) layout
+        (halo + padded rows zero). Same surface as
+        ``PackedHostData.scatter_per_atom``."""
         P = self.spatial_parts * self.batch_parts
-        out = np.zeros((P, self.n_cap, 3), dtype=dtype)
-        for i, pos in enumerate(positions_list):
-            pos = np.asarray(pos)
+        trail = np.shape(np.asarray(arrays[0]))[1:]
+        out = np.zeros((P, self.n_cap) + trail, dtype=dtype)
+        for i, arr in enumerate(arrays):
+            arr = np.asarray(arr)
             for p, start, count, gids in self.layout[i]:
-                out[p, start:start + count] = pos[gids]
+                out[p, start:start + count] = arr[gids]
+        return out
+
+    def atom_slots(self) -> np.ndarray:
+        """(P, N_cap) int32 FLAT (shard-major) energy-slot of each owned
+        node row; halo and padded rows carry the total-slot sentinel
+        ``batch_parts * batch_size``. The mesh counterpart of
+        ``PackedHostData.atom_slots`` — aligns per-atom arrays with the
+        runtime's flat ``energies``/``strain_grad`` outputs."""
+        P = self.spatial_parts * self.batch_parts
+        total = self.batch_parts * self.batch_size
+        out = np.full((P, self.n_cap), total, dtype=np.int32)
+        slots = self.structure_slots
+        for i in range(self.num_structures):
+            for p, start, count, _gids in self.layout[i]:
+                out[p, start:start + count] = slots[i]
         return out
 
     def gather_per_structure(self, packed: np.ndarray) -> list:

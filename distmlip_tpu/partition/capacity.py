@@ -89,6 +89,38 @@ class FixedCaps:
         return cap
 
 
+def fixed_caps_for_batches(per_structure_needs, batch_size: int,
+                           policy=None) -> FixedCaps:
+    """Worst-case-stable capacities for micro-batches drawn from a KNOWN
+    population (the training regime: the dataset is enumerable up front,
+    unlike a serving stream).
+
+    ``per_structure_needs`` is one dict per structure ({"nodes": n,
+    "edges": e, ...}); the worst case any ``batch_size``-subset can need is
+    the sum of the top-``batch_size`` values per name. That bound is
+    quantized ONCE through ``policy`` (default: a fresh ``BucketPolicy``)
+    and frozen into a :class:`FixedCaps` — every pack of every shuffled
+    epoch then lands on IDENTICAL static shapes, so a whole training run
+    compiles exactly one step executable per accumulation window
+    (train/data.PackedBatchLoader builds its packs through this).
+    """
+    if not per_structure_needs:
+        raise ValueError("fixed_caps_for_batches needs at least one "
+                         "structure's capacity needs")
+    batch_size = max(int(batch_size), 1)
+    policy = policy or BucketPolicy()
+    names = set()
+    for need in per_structure_needs:
+        names.update(need)
+    caps = {}
+    for name in sorted(names):
+        vals = sorted((int(n.get(name, 0)) for n in per_structure_needs),
+                      reverse=True)
+        worst = sum(vals[:batch_size])
+        caps[name] = policy.get(name, worst) if worst else 0
+    return FixedCaps(caps, fallback=policy)
+
+
 class CapacityPolicy:
     """Sticky capacities: grow in buckets, never shrink (per process).
 

@@ -23,7 +23,7 @@ Quick start::
     tel.close()
 """
 
-from .record import PHASE_KEYS, StepRecord
+from .record import PHASE_KEYS, StepRecord, TrainRecord
 from .sinks import (AggregatingSink, JsonlSink, StderrSummarySink, Telemetry,
                     TelemetrySink)
 from .trace import annotate, device_trace, scope, set_tracing, tracing_enabled
@@ -31,6 +31,7 @@ from .trace import annotate, device_trace, scope, set_tracing, tracing_enabled
 __all__ = [
     "PHASE_KEYS",
     "StepRecord",
+    "TrainRecord",
     "Telemetry",
     "TelemetrySink",
     "AggregatingSink",

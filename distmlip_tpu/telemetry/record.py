@@ -186,6 +186,45 @@ class StepRecord:
         return worst
 
 
+@dataclass
+class TrainRecord(StepRecord):
+    """One optimizer step of a training run (train/loop.py).
+
+    Subclasses :class:`StepRecord` so every existing sink consumes it
+    unchanged; a reader parsing mixed JSONL as ``StepRecord`` sees the
+    training fields ride along in ``extra`` (``from_dict`` keeps unknown
+    keys), and the report's training section reads them from either place.
+    """
+
+    kind: str = "train_step"
+
+    # --- loss decomposition (this step's micro-batch mean, fp32) ---
+    loss: float = 0.0
+    loss_energy: float = 0.0
+    loss_force: float = 0.0
+    loss_stress: float = 0.0
+    val_loss: float = float("nan")   # NaN = no eval ran this step
+
+    # --- optimizer dynamics ---
+    grad_norm: float = 0.0           # global grad norm BEFORE clipping
+    loss_scale: float = 0.0          # dynamic loss scale after this step
+    skipped: bool = False            # nonfinite grads: update skipped
+    epoch: int = 0
+
+    # --- schedule shape ---
+    accum_steps: int = 0             # micro-batches per optimizer step
+    micro_batch_size: int = 0        # structures per micro-batch
+    examples_per_sec: float = 0.0    # structures consumed / step wall time
+
+    @staticmethod
+    def training_field(record: "StepRecord", name: str, default=0.0):
+        """Read a training field off a live TrainRecord OR a StepRecord
+        re-parsed from JSONL (where the field rides in ``extra``)."""
+        if name in getattr(record, "extra", {}):
+            return record.extra[name]
+        return getattr(record, name, default)
+
+
 # ---------------------------------------------------------------------------
 # shared phase-statistics helpers (one implementation for the live
 # AggregatingSink and the offline report — the two tables must not drift)

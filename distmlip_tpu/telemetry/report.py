@@ -40,7 +40,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass, field
 
-from .record import (StepRecord, format_phase_table, percentile,
+from .record import (StepRecord, TrainRecord, format_phase_table, percentile,
                      phase_stats_from_samples)
 
 
@@ -161,6 +161,25 @@ class Report:
                 f"  rejects={s['rejects']} "
                 f"deadline_misses={s['deadline_misses']} "
                 f"fallback_batches={s['fallback_batches']}")
+        if c.get("training"):
+            t = c["training"]
+            out.append("")
+            out.append("training (train/loop.py):")
+            out.append(
+                f"  steps={t['steps']} epochs={t['epochs']} "
+                f"accum={t['accum_steps']} "
+                f"micro_batch={t['micro_batch_size']} "
+                f"examples/s mean={t['mean_examples_per_sec']:.1f}")
+            out.append(
+                f"  loss first={t['first_loss']:.4g} "
+                f"last={t['last_loss']:.4g} min={t['min_loss']:.4g}"
+                + (f"  val best={t['best_val_loss']:.4g}"
+                   if "best_val_loss" in t else ""))
+            out.append(
+                f"  grad_norm p50={t['grad_norm_p50']:.3g} "
+                f"p95={t['grad_norm_p95']:.3g}  "
+                f"loss_scale last={t['last_loss_scale']:.3g}  "
+                f"skipped_steps={t['skipped_steps']}")
         if ("max_hbm_used_frac" in c or "max_est_peak_bytes" in c):
             bits = []
             if "max_hbm_used_frac" in c:
@@ -395,6 +414,45 @@ def aggregate(
             "rejects": max(r.reject_count for r in serve),
             "deadline_misses": max(r.deadline_miss_count for r in serve),
         }
+
+    # --- training loop: loss trajectory + optimizer dynamics ---
+    train = [r for r in records if r.kind == "train_step"]
+    if train:
+        tf = TrainRecord.training_field
+        losses = [float(tf(r, "loss")) for r in train]
+        norms = sorted(float(tf(r, "grad_norm")) for r in train)
+        vals = [float(tf(r, "val_loss", float("nan"))) for r in train]
+        vals = [v for v in vals if v == v]  # drop NaN (no eval that step)
+        eps = [float(tf(r, "examples_per_sec")) for r in train]
+        skipped = sum(bool(tf(r, "skipped", False)) for r in train)
+        t = {
+            "steps": len(train),
+            "epochs": int(max(tf(r, "epoch", 0) for r in train)) + 1,
+            "accum_steps": int(max(tf(r, "accum_steps", 0) for r in train)),
+            "micro_batch_size": int(max(
+                tf(r, "micro_batch_size", 0) for r in train)),
+            "mean_examples_per_sec": sum(eps) / len(eps),
+            "first_loss": losses[0],
+            "last_loss": losses[-1],
+            "min_loss": min(losses),
+            "grad_norm_p50": percentile(norms, 0.50),
+            "grad_norm_p95": percentile(norms, 0.95),
+            "last_loss_scale": float(tf(train[-1], "loss_scale")),
+            "skipped_steps": skipped,
+        }
+        if vals:
+            t["best_val_loss"] = min(vals)
+        c["training"] = t
+        # skipped-step dominance: the dynamic loss scale exists to absorb
+        # the OCCASIONAL overflow — a run skipping a large fraction of its
+        # updates is diverging (or the scale is thrashing), not training
+        if len(train) >= 4 and skipped > 0.25 * len(train):
+            rep.anomalies.append(Anomaly(
+                "train_skipped_steps", 0,
+                f"{skipped}/{len(train)} optimizer steps skipped on "
+                f"nonfinite grads — loss scale thrashing or divergence "
+                f"(last scale {t['last_loss_scale']:.3g}); lower the LR "
+                f"or the initial loss scale"))
 
     # --- anomalies ---
     # stall detection is PER KIND: a DeviceMD chunk legitimately takes

@@ -1,0 +1,81 @@
+"""Distributed training subsystem over the 2-D mesh.
+
+The historical ``distmlip_tpu/train.py`` module grew into this package;
+its entire surface (``make_loss_fn`` / ``make_train_step`` /
+``make_batched_train_step`` / ``make_eval_fn`` / ``stack_graphs`` /
+``stack_targets`` / ``save_train_state`` / ``load_train_state``) remains
+importable from ``distmlip_tpu.train`` unchanged (now defined in
+:mod:`.legacy`). The subsystem proper:
+
+- :mod:`.data` — labeled-structure datasets, deterministic seeded
+  shuffling, bucket-aware block-diagonal packing at frozen worst-case
+  capacities, target packing into the padded local layout, and a
+  double-buffered host-side prefetch loader with a 3-integer resumable
+  cursor;
+- :mod:`.step` — ``TrainState`` (fp32 master weights, optimizer state,
+  EMA, dynamic loss scale, rng), the packed multi-structure loss, and the
+  accumulated mixed-precision step: ``lax.scan`` over micro-batches,
+  global-norm clipping, nonfinite-skip loss-scale dynamics, and ZeRO-1
+  optimizer-state sharding over the mesh's batch axis (psum grads via the
+  shard_map transpose, one all_gather of updated params);
+- :mod:`.loop` — ``Trainer``: epoch/step loop, periodic EMA eval,
+  best-model tracking, per-step :class:`~distmlip_tpu.telemetry.TrainRecord`
+  telemetry, and static-HBM-planner micro-batch auto-sizing
+  (``micro_batch_size="auto"`` / up-front over-budget rejection);
+- :mod:`.checkpoint` — async atomic resumable checkpoints carrying the
+  full TrainState + loader cursor, making mid-epoch resume bitwise.
+
+Quick start::
+
+    from distmlip_tpu.train import Sample, TrainConfig, Trainer
+
+    data = [Sample(atoms, energy, forces) for ...]
+    trainer = Trainer(model.energy_fn, params, optax.adam(1e-3), data,
+                      cutoff=model.cfg.cutoff, micro_batch_size=4,
+                      config=TrainConfig(accum_steps=2, precision="bf16"),
+                      val_samples=held_out, checkpoint_dir="ckpts")
+    trainer.fit(epochs=10)
+"""
+
+from .checkpoint import TrainCheckpointer, latest_checkpoint
+from .data import (PackedBatchLoader, Sample, TrainBatch, epoch_permutation,
+                   labelled_dataset, pack_targets)
+from .legacy import (load_train_state, make_batched_train_step, make_eval_fn,
+                     make_loss_fn, make_train_step, save_train_state,
+                     stack_graphs, stack_targets)
+from .loop import Trainer, estimate_step_peak_bytes
+from .step import (TrainConfig, TrainState, init_train_state,
+                   make_accum_train_step, make_eval_step,
+                   make_packed_loss_fn, resolve_zero1)
+
+__all__ = [
+    # legacy surface (the historical train.py module)
+    "make_loss_fn",
+    "make_train_step",
+    "make_batched_train_step",
+    "make_eval_fn",
+    "stack_graphs",
+    "stack_targets",
+    "save_train_state",
+    "load_train_state",
+    # data pipeline
+    "Sample",
+    "labelled_dataset",
+    "PackedBatchLoader",
+    "TrainBatch",
+    "pack_targets",
+    "epoch_permutation",
+    # step
+    "TrainConfig",
+    "TrainState",
+    "init_train_state",
+    "make_accum_train_step",
+    "make_packed_loss_fn",
+    "make_eval_step",
+    "resolve_zero1",
+    # loop + checkpointing
+    "Trainer",
+    "estimate_step_peak_bytes",
+    "TrainCheckpointer",
+    "latest_checkpoint",
+]

@@ -1,0 +1,155 @@
+"""Resumable training checkpoints: async, atomic, bitwise.
+
+A checkpoint is ONE npz (``utils/checkpoint`` format — portable,
+inspectable with ``np.load``) holding the complete resume story:
+
+- the full :class:`~distmlip_tpu.train.step.TrainState` — fp32 master
+  weights, optimizer state (ZeRO-1 sharded layout included: the (Bm, K)
+  leaves save/restore like any array), applied-step count, EMA weights,
+  dynamic loss scale + its growth counter, and the rng key;
+- the data-loader cursor (seed, epoch, step) — with the deterministic
+  epoch permutation this replays the EXACT remaining stream, so a resumed
+  run's losses are BITWISE identical to the uninterrupted run
+  (tests/test_train_subsystem.py pins this mid-epoch).
+
+Writes are async (``utils.checkpoint.AsyncSaver``: host materialization
+is synchronous — the only safe point, the step DONATES state buffers —
+compression and disk ride a background thread) and atomic (tmp + rename),
+with pruned retention and separate best-model tracking.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+from ..utils.checkpoint import AsyncSaver, load_params
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+BEST_NAME = "best.npz"
+
+
+def _loader_state_tree(loader_state: dict | None) -> dict:
+    s = loader_state or {}
+    return {"seed": np.int64(s.get("seed", 0)),
+            "epoch": np.int64(s.get("epoch", 0)),
+            "step": np.int64(s.get("step", 0))}
+
+
+def latest_checkpoint(directory: str) -> str | None:
+    """Path of the newest ``ckpt-NNNNNNNN.npz`` in ``directory`` (by step
+    number, not mtime — a restored-then-resaved old step must not win)."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return None
+    best = None
+    for name in names:
+        m = _CKPT_RE.match(name)
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), name)
+    return os.path.join(directory, best[1]) if best else None
+
+
+class TrainCheckpointer:
+    """Periodic + best-model checkpoint writer for one training run.
+
+    ``save(state, loader_state, step)`` enqueues an async atomic write of
+    ``ckpt-{step:08d}.npz`` and prunes to the ``keep`` newest;
+    ``save_best`` mirrors the state to ``best.npz`` on its own writer
+    thread (a periodic write in flight never blocks a best write).
+    ``wait()`` joins both writers — call it before reading files back or
+    exiting."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = max(int(keep), 1)
+        os.makedirs(directory, exist_ok=True)
+        self._saver = AsyncSaver()
+        self._best_saver = AsyncSaver()
+        self.best_metric: float | None = None
+
+    # ---- writing ----
+
+    def _payload(self, state, loader_state):
+        # best_metric rides every checkpoint so a RESUMED run keeps the
+        # true best: without it, the first (possibly worse) eval after a
+        # restore would overwrite best.npz
+        best = self.best_metric if self.best_metric is not None else np.inf
+        return {"state": state,
+                "loader": _loader_state_tree(loader_state),
+                "best_metric": np.float64(best)}
+
+    def save(self, state, loader_state: dict | None = None,
+             step: int | None = None) -> str:
+        step = int(state.step) if step is None else int(step)
+        name = f"ckpt-{step:08d}.npz"
+        path = os.path.join(self.directory, name)
+        self._saver.save(path, self._payload(state, loader_state))
+        self._prune(incoming=name)
+        return path
+
+    def save_best(self, state, metric: float,
+                  loader_state: dict | None = None) -> bool:
+        """Write ``best.npz`` iff ``metric`` improves on the best seen
+        (lower is better). Returns whether it did."""
+        if self.best_metric is not None and metric >= self.best_metric:
+            return False
+        self.best_metric = float(metric)
+        self._best_saver.save(os.path.join(self.directory, BEST_NAME),
+                              self._payload(state, loader_state))
+        return True
+
+    def _prune(self, incoming: str | None = None) -> None:
+        """Keep the ``keep`` newest checkpoints, counting a just-enqueued
+        async write as present (its file may not exist yet — pruning by
+        listdir alone would leave keep+1 files on disk at steady state)."""
+        entries = set()
+        for name in os.listdir(self.directory):
+            m = _CKPT_RE.match(name)
+            if m:
+                entries.add((int(m.group(1)), name))
+        if incoming is not None:
+            m = _CKPT_RE.match(incoming)
+            if m:
+                entries.add((int(m.group(1)), incoming))
+        for _, name in sorted(entries)[:-self.keep]:
+            try:
+                os.remove(os.path.join(self.directory, name))
+            except OSError:  # pragma: no cover - concurrent cleanup
+                pass
+
+    def wait(self) -> None:
+        self._saver.wait()
+        self._best_saver.wait()
+
+    # ---- reading ----
+
+    def _load(self, state_like, path):
+        tree = load_params(path, like=self._payload(state_like, None))
+        best = float(tree.get("best_metric", np.inf))
+        if np.isfinite(best) and (self.best_metric is None
+                                  or best < self.best_metric):
+            self.best_metric = best
+        return tree["state"], {k: int(v) for k, v in tree["loader"].items()}
+
+    def restore(self, state_like, path: str | None = None):
+        """Load ``(state, loader_state)`` from ``path`` (default: the
+        newest periodic checkpoint). ``state_like`` is a template
+        TrainState (e.g. a freshly built one) fixing tree structure and
+        dtypes — exactly what makes the restore bitwise. Also restores
+        ``best_metric`` so best-model tracking survives the resume."""
+        self.wait()
+        if path is None:
+            path = latest_checkpoint(self.directory)
+            if path is None:
+                raise FileNotFoundError(
+                    f"no ckpt-*.npz checkpoints in {self.directory!r}")
+        return self._load(state_like, path)
+
+    def restore_best(self, state_like):
+        self.wait()
+        return self._load(state_like,
+                          os.path.join(self.directory, BEST_NAME))

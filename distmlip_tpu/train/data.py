@@ -1,0 +1,401 @@
+"""Training data pipeline: labeled structures -> packed, prefetched batches.
+
+The block-diagonal packer (PR 3) is exactly the right substrate for
+variable-size molecular training data (cf. arXiv 2504.10700 on data
+distribution for MACE training): every micro-batch packs ``B`` structures
+into ONE padded super-graph, so a whole micro-batch moves through the
+device as one program. This module adds the training-specific layers on
+top:
+
+- **deterministic seeded shuffling** — the epoch order is a pure function
+  of ``(seed, epoch)`` (:func:`epoch_permutation`), so a resumed run
+  replays the EXACT stream an uninterrupted run would have seen (the
+  bitwise-resume contract in tests/test_train_subsystem.py);
+- **shape-stable bucketing** — the training set is enumerable up front
+  (unlike a serving stream), so the loader precomputes the worst-case
+  micro-batch capacities once (:func:`partition.fixed_caps_for_batches`)
+  and packs EVERY batch of every epoch at those frozen shapes: one step
+  executable per accumulation window for the whole run, under the same
+  logarithmic ladder quantization serving uses;
+- **target packing** — energies/forces/stresses land in the padded local
+  layout of the graph they train against (owned-row force masks via
+  ``atom_slots``; strain-gradient stress slots via ``structure_slots``);
+- **host-side prefetch** — a double-buffered background builder thread
+  overlaps neighbor lists + packing of batch k+1 with the device step on
+  batch k. No wallclock enters the jitted program; the loader hands the
+  step plain arrays.
+
+The loader's cursor (``state()``/``set_state()``) is three integers —
+(seed, epoch, step) — which is what makes mid-epoch checkpoint resume
+bitwise (train/checkpoint.py persists it next to the model state).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, NamedTuple
+
+import numpy as np
+
+from ..neighbors import neighbor_list
+from ..partition import (BucketPolicy, bucket_key, fixed_caps_for_batches,
+                         pack_structures)
+from ..partition.partitioner import build_plan
+
+
+class Sample(NamedTuple):
+    """One labeled structure: geometry + regression targets."""
+
+    atoms: Any                 # calculators.Atoms (positions/cell/pbc/numbers)
+    energy: float              # total energy (eV)
+    forces: np.ndarray         # (n, 3) eV/Å
+    stress: np.ndarray | None = None  # (3, 3) eV/Å^3, optional
+
+
+def labelled_dataset(structures, energies, forces, stresses=None):
+    """Zip parallel lists into a ``list[Sample]`` dataset."""
+    if stresses is None:
+        stresses = [None] * len(structures)
+    if not (len(structures) == len(energies) == len(forces)
+            == len(stresses)):
+        raise ValueError(
+            f"dataset lists disagree: {len(structures)} structures, "
+            f"{len(energies)} energies, {len(forces)} forces, "
+            f"{len(stresses)} stresses")
+    return [Sample(a, float(e), np.asarray(f), s)
+            for a, e, f, s in zip(structures, energies, forces, stresses)]
+
+
+def epoch_permutation(n: int, seed: int, epoch: int) -> np.ndarray:
+    """The deterministic visit order of epoch ``epoch``: a pure function
+    of (seed, epoch) — no hidden generator state — so any consumer
+    (loader, resume, tests) recomputes the identical permutation."""
+    return np.random.default_rng([int(seed), int(epoch)]).permutation(n)
+
+
+@dataclass
+class TrainBatch:
+    """One optimizer step's worth of data: ``accum_steps`` stacked packed
+    micro-batches. ``graphs``/``targets`` pytree leaves carry a leading
+    accumulation axis A — ``lax.scan`` food for the accumulated step."""
+
+    graphs: Any                # stacked PartitionedGraph pytree (A, ...)
+    targets: Any               # stacked target dict (A, ...)
+    meta: dict = field(default_factory=dict)
+
+
+def pack_targets(graph, host, samples, dtype=np.float32) -> dict:
+    """Pack per-structure targets into ``graph``'s padded local layout.
+
+    Returns the target pytree the packed loss (train/step.py) consumes:
+
+    - ``energy`` (B_total,): per-slot total energies (0 on empty slots);
+    - ``forces`` (P, N_cap, 3): owned-row force targets, packed exactly
+      like positions (halo/padded rows 0);
+    - ``atom_slot`` (P, N_cap) int32: each row's flat energy slot, with
+      the B_total sentinel on halo/padded rows — the loss derives its
+      owned-row force mask AND the per-structure 1/(3n) normalization
+      from this one array;
+    - ``n_atoms`` (B_total,): real atoms per slot (1 on empty slots so
+      divisions stay finite; the mask zeroes their contribution);
+    - ``struct_mask`` (B_total,): 1.0 on slots holding a real structure;
+    - ``stress`` (B_total, 3, 3) + ``inv_volume`` (B_total,): present
+      only when EVERY sample carries a stress target (the runtime's
+      strain gradient divides by volume per structure).
+    """
+    B_total = max(graph.batch_parts, 1) * graph.batch_size
+    slots = host.structure_slots
+    energy = np.zeros(B_total, dtype=dtype)
+    n_atoms = np.ones(B_total, dtype=dtype)
+    struct_mask = np.zeros(B_total, dtype=dtype)
+    for i, s in enumerate(samples):
+        energy[slots[i]] = s.energy
+        n_atoms[slots[i]] = max(len(s.forces), 1)
+        struct_mask[slots[i]] = 1.0
+    targets = {
+        "energy": energy,
+        "forces": host.scatter_per_atom([s.forces for s in samples],
+                                        dtype=dtype),
+        "atom_slot": host.atom_slots(),
+        "n_atoms": n_atoms,
+        "struct_mask": struct_mask,
+    }
+    if all(s.stress is not None for s in samples):
+        stress = np.zeros((B_total, 3, 3), dtype=dtype)
+        inv_vol = np.zeros(B_total, dtype=dtype)
+        for i, s in enumerate(samples):
+            stress[slots[i]] = s.stress
+            inv_vol[slots[i]] = 1.0 / max(float(host.volumes[i]), 1e-12)
+        targets["stress"] = stress
+        targets["inv_volume"] = inv_vol
+    return targets
+
+
+def _stack_host(trees):
+    """Stack a list of identically-shaped pytrees along a new leading
+    axis, on the HOST (numpy — the loader thread never touches a device;
+    jit moves the result once, when the step consumes it)."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *trees)
+
+
+class PackedBatchLoader:
+    """Deterministic, resumable, prefetching loader of packed train batches.
+
+    Each :meth:`next_batch` returns one :class:`TrainBatch`: ``accum_steps``
+    micro-batches of ``micro_batch_size`` structures, each packed
+    block-diagonally (``pack_structures``) at FROZEN worst-case capacities
+    so every batch of the run shares one executable, stacked along a
+    leading scan axis. Epoch order is :func:`epoch_permutation`; tail
+    structures that don't fill a full accumulation window are dropped
+    (shape stability — grad-accumulation parity needs equal-B windows).
+
+    ``batch_parts``/``spatial_parts`` select the 2-D mesh placement of
+    every pack. With ``spatial_parts == 1`` (the data-parallel training
+    regime) shapes are frozen via :func:`fixed_caps_for_batches`; spatial
+    slab packing falls back to the shared geometric ladder (slab halos
+    make the worst-case pre-computation structure-dependent), which keeps
+    compiles logarithmic rather than exactly one.
+
+    The cursor is ``state() -> {"seed", "epoch", "step"}``; ``set_state``
+    repositions the stream EXACTLY (the prefetcher restarts from the new
+    cursor). ``close()`` stops the background builder.
+    """
+
+    def __init__(self, samples, cutoff: float, micro_batch_size: int,
+                 accum_steps: int = 1, bond_cutoff: float = 0.0,
+                 use_bond_graph: bool = False, caps=None, species_fn=None,
+                 seed: int = 0, shuffle: bool = True, batch_parts: int = 1,
+                 spatial_parts: int = 1, system: dict | None = None,
+                 num_threads: int | None = None, prefetch: int = 2,
+                 dtype=np.float32, precomputed_needs=None):
+        if not samples:
+            raise ValueError("PackedBatchLoader needs at least one sample")
+        B, A = int(micro_batch_size), int(accum_steps)
+        if B < 1 or A < 1:
+            raise ValueError(
+                f"micro_batch_size/accum_steps must be >= 1, got {B}/{A}")
+        if len(samples) < B * A:
+            raise ValueError(
+                f"dataset has {len(samples)} structures but one optimizer "
+                f"step consumes micro_batch_size * accum_steps = {B * A}")
+        self.samples = list(samples)
+        self.cutoff = float(cutoff)
+        self.bond_cutoff = float(bond_cutoff)
+        self.use_bond_graph = bool(use_bond_graph)
+        self.micro_batch_size = B
+        self.accum_steps = A
+        self.species_fn = species_fn
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.batch_parts = int(batch_parts)
+        self.spatial_parts = int(spatial_parts)
+        self.system = system
+        self.num_threads = num_threads
+        self.dtype = dtype
+        self._epoch = 0
+        self._step = 0
+        ladder = caps or BucketPolicy()
+        # per-structure capacity needs: computed once (or handed in by a
+        # caller probing several micro-batch sizes over one dataset —
+        # Trainer's memory-aware auto-sizing) and frozen into the caps
+        self.needs = precomputed_needs
+        if self.spatial_parts == 1:
+            if self.needs is None:
+                self.needs = self.structure_needs()
+            self.caps = fixed_caps_for_batches(
+                self.needs,
+                -(-B // self.batch_parts),  # per batch shard
+                policy=ladder)
+        else:
+            self.caps = ladder
+        self._depth = max(int(prefetch), 0)
+        self._prefetcher = None
+
+    # ---- capacity planning ----
+
+    def structure_needs(self) -> list[dict]:
+        """Per-structure capacity needs (single-partition plan counts) —
+        computed ONCE at loader construction to freeze the run's shapes."""
+        needs = []
+        b_r = self.bond_cutoff if self.use_bond_graph else 0.0
+        for s in self.samples:
+            a = s.atoms
+            nl = neighbor_list(a.positions, a.cell, a.pbc, self.cutoff,
+                               bond_r=b_r, num_threads=self.num_threads)
+            plan = build_plan(nl, a.cell, a.pbc, 1, self.cutoff, b_r,
+                              self.use_bond_graph)
+            need = {"nodes": len(a.positions),
+                    "edges": len(plan.src_local[0])}
+            if self.use_bond_graph:
+                need.update(
+                    bonds=int(plan.bond_markers[0][-1]),
+                    lines=len(plan.line_src[0]),
+                    bond_map=len(plan.bond_mapping_edge[0]))
+            needs.append(need)
+        return needs
+
+    # ---- cursor ----
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return len(self.samples) // (self.micro_batch_size
+                                     * self.accum_steps)
+
+    def state(self) -> dict:
+        """The resumable cursor: batches CONSUMED so far (not built —
+        prefetched-but-undelivered batches are rebuilt on resume)."""
+        return {"seed": self.seed, "epoch": self._epoch, "step": self._step}
+
+    def set_state(self, state: dict) -> None:
+        self.close()
+        self.seed = int(state["seed"])
+        self._epoch = int(state["epoch"])
+        self._step = int(state["step"])
+
+    # ---- batch building ----
+
+    def _order(self, epoch: int) -> np.ndarray:
+        if self.shuffle:
+            return epoch_permutation(len(self.samples), self.seed, epoch)
+        return np.arange(len(self.samples))
+
+    def _build(self, epoch: int, step: int) -> TrainBatch:
+        """Build the (epoch, step) macro-batch — a pure function of the
+        cursor, which is the whole resume story."""
+        B, A = self.micro_batch_size, self.accum_steps
+        order = self._order(epoch)
+        start = step * B * A
+        graphs, targets = [], []
+        n_atoms_total = 0
+        for a_i in range(A):
+            idx = order[start + a_i * B:start + (a_i + 1) * B]
+            batch_samples = [self.samples[i] for i in idx]
+            graph, host = pack_structures(
+                [s.atoms for s in batch_samples], self.cutoff,
+                bond_cutoff=self.bond_cutoff,
+                use_bond_graph=self.use_bond_graph, caps=self.caps,
+                species_fn=self.species_fn, dtype=self.dtype,
+                system=self.system, num_threads=self.num_threads,
+                spatial_parts=self.spatial_parts,
+                batch_parts=self.batch_parts)
+            graphs.append(graph)
+            targets.append(pack_targets(graph, host, batch_samples,
+                                        dtype=self.dtype))
+            n_atoms_total += int(sum(len(s.forces) for s in batch_samples))
+        return TrainBatch(
+            graphs=_stack_host(graphs),
+            targets=_stack_host(targets),
+            meta={"epoch": epoch, "step": step,
+                  "bucket_key": bucket_key(graphs[0]),
+                  "n_structures": B * A, "n_atoms": n_atoms_total})
+
+    def _advance(self, epoch: int, step: int) -> tuple[int, int]:
+        step += 1
+        if step >= self.steps_per_epoch:
+            return epoch + 1, 0
+        return epoch, step
+
+    def next_batch(self) -> TrainBatch:
+        """The next macro-batch in cursor order (prefetched when a depth
+        was configured); advances the consumed cursor."""
+        if self._depth > 0:
+            if self._prefetcher is None:
+                self._prefetcher = _Prefetcher(
+                    self._build, self._advance,
+                    (self._epoch, self._step), self._depth)
+            batch, nxt = self._prefetcher.get()
+        else:
+            batch = self._build(self._epoch, self._step)
+            nxt = self._advance(self._epoch, self._step)
+        self._epoch, self._step = nxt
+        return batch
+
+    def eval_batch(self, samples) -> TrainBatch:
+        """A single stacked batch (A=1) over ``samples`` — the held-out
+        eval surface, packed at the SAME frozen caps as the train stream
+        when it fits (no extra executable for eval)."""
+        graph, host = pack_structures(
+            [s.atoms for s in samples], self.cutoff,
+            bond_cutoff=self.bond_cutoff,
+            use_bond_graph=self.use_bond_graph, caps=self.caps,
+            species_fn=self.species_fn, dtype=self.dtype,
+            system=self.system, num_threads=self.num_threads,
+            spatial_parts=self.spatial_parts, batch_parts=self.batch_parts)
+        targets = pack_targets(graph, host, samples, dtype=self.dtype)
+        return TrainBatch(
+            graphs=_stack_host([graph]), targets=_stack_host([targets]),
+            meta={"bucket_key": bucket_key(graph),
+                  "n_structures": len(samples)})
+
+    def close(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.stop()
+            self._prefetcher = None
+
+    def __del__(self):  # pragma: no cover - GC ordering
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class _Prefetcher:
+    """Double-buffered background batch builder.
+
+    Builds batches from its own cursor into a bounded queue; the consumer
+    pops ``(batch, next_cursor)`` pairs in order. A builder exception is
+    delivered to the consumer at the matching ``get()`` (not swallowed,
+    not fatal to the thread's queue discipline)."""
+
+    def __init__(self, build_fn, advance_fn, cursor, depth: int):
+        self._build = build_fn
+        self._advance = advance_fn
+        self._cursor = cursor
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="distmlip-train-prefetch", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        cursor = self._cursor
+        while not self._stop.is_set():
+            try:
+                item = (self._build(*cursor), self._advance(*cursor), None)
+            except BaseException as e:  # noqa: BLE001 - delivered at get()
+                item = (None, self._advance(*cursor), e)
+            cursor = item[1]
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def get(self):
+        while True:
+            try:
+                batch, nxt, err = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise RuntimeError(
+                        "train prefetch thread died without delivering")
+        if err is not None:
+            raise err
+        return batch, nxt
+
+    def stop(self):
+        self._stop.set()
+        # unblock a producer stuck on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)

@@ -1,10 +1,18 @@
-"""Training: energy/force/stress matching over the graph-parallel mesh.
+"""Legacy single-program training surface (the historical ``train.py``).
 
 The reference is inference-only (training stays in upstream libraries,
 reference README.md:53); here training is first-class: the loss
 differentiates through the same sharded potential (halo exchanges included),
 so gradients w.r.t. parameters aggregate across partitions with a psum —
 graph parallelism doubles as data parallelism over space.
+
+This module is the recipe-sized surface: one jitted step per call, stacked
+same-bucket graphs, npz checkpoint of (params, opt_state, step). The full
+subsystem — packed-batch data pipeline, gradient accumulation, mixed
+precision, ZeRO-1 sharded optimizer state, resumable async checkpoints —
+lives in the sibling modules (:mod:`distmlip_tpu.train.data` /
+``step`` / ``loop`` / ``checkpoint``); everything here stays supported and
+re-exported from :mod:`distmlip_tpu.train`.
 """
 
 from __future__ import annotations
@@ -12,7 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .parallel.runtime import make_total_energy
+from ..parallel.runtime import make_total_energy
 
 
 def make_loss_fn(model_energy_fn, mesh, w_energy=1.0, w_force=1.0, w_stress=0.0):
@@ -154,7 +162,7 @@ def make_eval_fn(model_energy_fn, mesh, w_energy=1.0, w_force=1.0,
 
 def save_train_state(path: str, params, opt_state, step: int) -> None:
     """One npz with the full resumable state (utils/checkpoint format)."""
-    from .utils.checkpoint import save_params
+    from ..utils.checkpoint import save_params
 
     save_params(path, {"params": params, "opt_state": opt_state,
                        "step": jnp.asarray(step)})
@@ -162,7 +170,7 @@ def save_train_state(path: str, params, opt_state, step: int) -> None:
 
 def load_train_state(path: str, params_like, opt_state_like):
     """Restore (params, opt_state, step) saved by save_train_state."""
-    from .utils.checkpoint import load_params
+    from ..utils.checkpoint import load_params
 
     state = load_params(path, like={"params": params_like,
                                     "opt_state": opt_state_like,

@@ -9,6 +9,9 @@ later for multi-host async checkpointing.
 
 from __future__ import annotations
 
+import os
+import threading
+
 import numpy as np
 
 # In-memory tensor-layout era the saved parameters assume. Version 2 is the
@@ -39,9 +42,65 @@ def _flatten_with_paths(tree, prefix=""):
 
 
 def save_params(path: str, params) -> None:
+    """Atomic save: write to a sibling tmp file, then rename. A crash
+    mid-write never leaves a torn checkpoint at ``path`` — the previous
+    one (if any) survives intact."""
     flat = _flatten_with_paths(params)
     flat[_LAYOUT_KEY] = np.int64(LAYOUT_VERSION)
-    np.savez_compressed(path, **flat)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        np.savez_compressed(tmp, **flat)
+        # np.savez appends .npz when the target lacks it
+        written = tmp if os.path.exists(tmp) else tmp + ".npz"
+        os.replace(written, path)
+    except BaseException:
+        for cand in (tmp, tmp + ".npz"):
+            if os.path.exists(cand):
+                os.remove(cand)
+        raise
+
+
+class AsyncSaver:
+    """Background-thread checkpoint writer for training loops.
+
+    ``save()`` materializes the pytree on the host SYNCHRONOUSLY (cheap:
+    device->host copies; also the only correct point — a donated
+    ``TrainState`` buffer is invalid the moment the next step dispatches)
+    and hands the compress+write to a worker thread, so the device never
+    idles on gzip/disk. One write in flight at a time: a new ``save()``
+    joins the previous one first (checkpoints are ordered); ``wait()``
+    joins the tail and re-raises any writer error.
+    """
+
+    def __init__(self):
+        self._thread = None
+        self._error = None
+
+    def save(self, path: str, params) -> None:
+        import jax
+
+        self.wait()
+        host_tree = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)), params)
+
+        def _write():
+            try:
+                save_params(path, host_tree)
+            except BaseException as e:  # noqa: BLE001 - surfaced by wait()
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=_write, name="distmlip-ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight write (if any); re-raise a writer failure."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
 
 def load_params(path: str, like=None, *, allow_legacy_layout: bool = False):

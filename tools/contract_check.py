@@ -292,6 +292,83 @@ def _trace_device_md(programs_out):
         config={"max_total_collectives": 0}))
 
 
+def _trace_train_step(programs_out, want=_want_all):
+    """The accumulated bf16 train-step programs (distmlip_tpu.train):
+    lax.scan over 2 micro-batches, fp32 master weights, dynamic loss
+    scaling, at (1,1) single-device (communication-free) and on the (2,1)
+    batch ring with ZeRO-1 optimizer-state sharding — where the batch
+    axis carries EXACTLY the ZeRO-1 budget: the shard_map transpose's
+    grad-reduction psums (at most one per param leaf per shard_map'd
+    energy program — two of those per micro-step, the forward and the
+    force backward) plus ONE tiled all_gather of the updated params.
+    Anything else on the batch axis is an ERROR."""
+    names = ("train_step[tensornet][1x1]", "train_step[tensornet][2x1]")
+    wanted = [n for n in names if want(n)]
+    if not wanted:
+        return
+    import jax
+    import numpy as np
+    import optax
+    from jax.experimental import enable_x64
+
+    from distmlip_tpu.analysis import Program
+    from distmlip_tpu.calculators import Atoms
+    from distmlip_tpu.parallel import BATCH_AXIS, device_mesh
+    from distmlip_tpu.train import (PackedBatchLoader, Sample, TrainConfig,
+                                    init_train_state, make_accum_train_step)
+
+    from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+
+    # bf16 COMPUTE model (the model's own curated mixed-precision switch)
+    # trained with fp32 master weights — the combination the dtype pass
+    # must prove clean (no half-precision scatter accumulation anywhere,
+    # fp32 optimizer arithmetic)
+    model = TensorNet(TensorNetConfig(
+        num_species=4, units=16, num_rbf=8, num_layers=2, cutoff=3.2,
+        dtype="bfloat16"))
+    params = model.init(jax.random.PRNGKey(0))
+    accum = 2
+    rng = np.random.default_rng(1)
+    cart, lattice, species = build_system((4, 2, 2))
+    samples = []
+    for _ in range(2 * accum):
+        pos = cart + rng.normal(0, 0.02, cart.shape)
+        samples.append(Sample(
+            Atoms(numbers=species + 1, positions=pos, cell=lattice),
+            float(rng.normal()),
+            rng.normal(0, 0.1, cart.shape).astype(np.float32)))
+    optimizer = optax.adam(1e-3)
+    n_leaves = len(jax.tree.leaves(params))
+    zero1_budget = {BATCH_AXIS: {
+        "psum": 2 * n_leaves * accum,   # audited grad-reduction allowance
+        "all_gather": 1,                # the ZeRO-1 param rebuild
+    }}
+    placements = (("1x1", None, 1, {"max_total_collectives": 0}),
+                  ("2x1", device_mesh(2, 1), 2,
+                   {"forbidden_axes": [BATCH_AXIS],
+                    "axis_budget": zero1_budget}))
+    for tag, mesh, batch_parts, coll_cfg in placements:
+        name = f"train_step[tensornet][{tag}]"
+        if name not in wanted:
+            continue
+        cfg = TrainConfig(accum_steps=accum, precision="bf16")
+        loader = PackedBatchLoader(
+            samples, model.cfg.cutoff, micro_batch_size=2,
+            accum_steps=accum,
+            species_fn=lambda z: (z - 1).astype("int32"),
+            batch_parts=batch_parts, prefetch=0)
+        state = init_train_state(optimizer, params, mesh, cfg, seed=0)
+        step = make_accum_train_step(model.energy_fn, optimizer, mesh, cfg)
+        batch = loader.next_batch()
+        loader.close()
+        with enable_x64():
+            jx = jax.make_jaxpr(step)(state, batch.graphs, batch.targets)
+        tags = {"grad", "x64", "train"} | ({"mesh"} if mesh else set())
+        programs_out.append(Program(
+            name=name, jaxpr=jx, tags=frozenset(tags),
+            config=dict(coll_cfg)))
+
+
 def run_lint(paths=None):
     """Repo-specific AST lint + ruff (when installed) over the package."""
     from distmlip_tpu.analysis import lint_paths
@@ -398,6 +475,7 @@ def main(argv=None) -> int:
                 _trace_packed_batch(programs)
             if want("device_md[pair][1x1]"):
                 _trace_device_md(programs)
+            _trace_train_step(programs, want)
         if args.hbm_budget_gb is not None:
             for prog in programs:
                 prog.config.setdefault(

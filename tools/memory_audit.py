@@ -98,6 +98,7 @@ def main(argv=None) -> int:
             cc._trace_packed_batch(programs)
         if want("device_md[pair][1x1]"):
             cc._trace_device_md(programs)
+        cc._trace_train_step(programs, want)
 
     budget = (int(args.budget_gb * 2**30)
               if args.budget_gb is not None else None)

@@ -144,141 +144,35 @@ _CANARY_SRC = None
 _CANARY_LOG = os.environ.get("BENCH_CANARY_LOG", "/tmp/bench_canary.log")
 
 
-def _kill_canary_group(proc):
-    """TERM -> grace -> KILL the canary's whole process group.
-
-    The canary runs in its own session (start_new_session=True), so its
-    pgid == its pid and any children it spawned die with it. Escalates to
-    SIGKILL after BENCH_CANARY_KILL_GRACE_S (default 10 s) and always
-    reaps the subprocess handle so no zombie outlives the bench."""
-    import signal
-
-    grace = float(os.environ.get("BENCH_CANARY_KILL_GRACE_S", "10"))
-    try:
-        pgid = os.getpgid(proc.pid)
-    except (ProcessLookupError, PermissionError):
-        proc.poll()
-        return
-    for sig, wait_s in ((signal.SIGTERM, grace), (signal.SIGKILL, 5.0)):
-        try:
-            os.killpg(pgid, sig)
-        except (ProcessLookupError, PermissionError):
-            break
-        try:
-            proc.wait(timeout=wait_s)
-            break
-        except subprocess.TimeoutExpired:
-            continue
-    proc.poll()  # reap
+def _launch_canary():
+    """Start the disposable canary subprocess (own session, file-backed
+    output so an orphaned canary never SIGPIPEs; inherit the environment —
+    never pass env= dicts while axon is live)."""
+    cmd = ([sys.executable, "-c", _CANARY_SRC] if _CANARY_SRC
+           else [sys.executable, _CANARY_SCRIPT])
+    with open(_CANARY_LOG, "ab") as log:
+        return subprocess.Popen(
+            cmd, stdout=log, stderr=log,
+            start_new_session=True)  # survives parent process-group kill
 
 
 def _canary_claim(watchdog):
     """Probe the chip grant with a DISPOSABLE subprocess before claiming.
 
-    Round-4 lesson (VERDICT r4 weak #1): `jax.devices()` on a wedged axon
-    grant HANGS, and the PARENT dying mid-claim — e.g. this bench
-    os._exit'ing under its own watchdog — renews the server-side lease
-    wedge. So the risky first claim happens in a canary subprocess: if it
-    exits 0 the grant is healthy and the parent claims in-process; if it
-    raises we retry/fail structured; if it neither exits nor fails within
-    the budget the grant is wedged and the canary is KILLED (process-group
-    TERM -> grace -> KILL, reported as ``canary: killed``). Round-6 lesson
-    (BENCH_r05): the earlier leave-it-running policy leaked the pid
-    (``canary: left_running``) — the orphan held its pending claim long
-    after the round ended, serializing against the NEXT round's probe.
-    Killing the disposable canary is safe precisely because the parent
-    never started a claim of its own.
+    The canary/kill/re-probe machinery (round-4/5/6 lessons: a wedged
+    grant HANGS the claim, the parent must never die mid-claim, a stuck
+    canary must be killed not leaked, and one bounded re-probe may
+    recover a kill-released lease) lives in
+    ``distmlip_tpu.utils.health.CanaryProber`` — shared with the serving
+    fleet's replica-health monitor. Budgets come from the BENCH_* env
+    knobs (``ProbeConfig.from_env``), telemetry lands in ``_TELEMETRY``.
 
     Returns (ok: bool, detail: str). Never raises.
     """
-    claim_budget = float(os.environ.get("BENCH_CLAIM_TIMEOUT_S", "420"))
-    retries = max(1, int(os.environ.get("BENCH_RETRIES", "3")))
-    backoff = float(os.environ.get("BENCH_RETRY_BACKOFF_S", "30"))
-    max_reprobes = max(0, int(os.environ.get("BENCH_WEDGE_REPROBES", "1")))
-    t_end = time.monotonic() + claim_budget
-    # backup only — the poll loop below enforces the budget without hanging
-    watchdog.phase(
-        f"canary claim phase overran {claim_budget + 60:.0f}s",
-        claim_budget + 60)
-    detail = "canary never launched"
-    attempt = 0
-    while attempt < retries:
-        _TELEMETRY["probe_attempts"] += 1
-        t0 = time.monotonic()
-        # inherit the environment (never pass env= dicts while axon is
-        # live); file-backed output so an orphaned canary never SIGPIPEs
-        cmd = ([sys.executable, "-c", _CANARY_SRC] if _CANARY_SRC
-               else [sys.executable, _CANARY_SCRIPT])
-        with open(_CANARY_LOG, "ab") as log:
-            proc = subprocess.Popen(
-                cmd, stdout=log, stderr=log,
-                start_new_session=True)  # survives parent process-group kill
-        while time.monotonic() < t_end:
-            rc = proc.poll()
-            if rc is not None:
-                break
-            time.sleep(2.0)
-        elapsed = time.monotonic() - t0
-        _TELEMETRY["canary_elapsed_s"] = round(elapsed, 1)
-        rc = proc.poll()
-        if rc is None:
-            # Budget exhausted, canary still mid-claim: the grant is
-            # wedged. Kill the disposable canary's process group instead
-            # of leaking it (BENCH_r05's `canary: left_running` pid).
-            _kill_canary_group(proc)
-            _TELEMETRY["canary"] = "killed"
-            _TELEMETRY["wedge_suspected"] = True
-            _TELEMETRY["canary_pid"] = proc.pid
-            detail = (
-                f"canary claim still pending after {elapsed:.0f}s "
-                f"(chip grant wedged; canary pid {proc.pid} killed, "
-                f"log {_CANARY_LOG})")
-            if _TELEMETRY["wedge_reprobes"] < max_reprobes:
-                # BENCH_r05 follow-up: killing the stuck claimer can itself
-                # release the server-side lease — ONE bounded re-probe with
-                # backoff before declaring the backend unavailable, so a
-                # transient wedge doesn't cost the whole round. The re-probe
-                # gets its own (clamped) budget; a second wedge fails for
-                # good.
-                _TELEMETRY["wedge_reprobes"] += 1
-                reprobe_budget = min(float(os.environ.get(
-                    "BENCH_WEDGE_REPROBE_TIMEOUT_S", "120")), claim_budget)
-                wait = min(backoff, max(claim_budget / 4.0, 1.0))
-                print(f"# {detail}; re-probing once in {wait:.0f}s "
-                      f"(budget {reprobe_budget:.0f}s)", file=sys.stderr)
-                watchdog.phase(
-                    f"wedge re-probe overran {reprobe_budget + wait + 60:.0f}s",
-                    reprobe_budget + wait + 60)
-                time.sleep(wait)
-                t_end = time.monotonic() + reprobe_budget
-                continue  # relaunch without consuming a regular retry
-            return False, detail
-        if rc == 0:
-            _TELEMETRY["canary"] = "ok"
-            return True, f"canary healthy in {elapsed:.0f}s"
-        # canary raised (e.g. UNAVAILABLE fast-fail): retry within budget
-        _TELEMETRY["canary"] = "unavailable"
-        tail = ""
-        try:
-            with open(_CANARY_LOG, "rb") as f:
-                tail = f.read()[-400:].decode("utf-8", "replace")
-        except OSError:
-            pass
-        detail = (f"canary exited rc={rc} after {elapsed:.0f}s "
-                  f"(attempt {attempt + 1}/{retries}): {tail.strip()[-200:]}")
-        print(f"# {detail}", file=sys.stderr)
-        attempt += 1
-        wait = backoff * attempt
-        # only launch a retry canary if the remaining budget could actually
-        # see it through (scaled by how long this one took to fail) — a
-        # canary launched into seconds of budget would be misreported as
-        # left_running/wedged when the grant was merely slow-failing
-        need = max(60.0, 1.5 * elapsed)
-        if attempt < retries and time.monotonic() + wait + need < t_end:
-            time.sleep(wait)
-        else:
-            break  # out of claim budget; fail structured, don't hang
-    return False, detail
+    from distmlip_tpu.utils.health import CanaryProber
+
+    return CanaryProber(_launch_canary, telemetry=_TELEMETRY,
+                        phase=watchdog.phase, log_path=_CANARY_LOG).run()
 
 
 def _claim_backend(watchdog):

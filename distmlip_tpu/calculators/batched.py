@@ -469,6 +469,12 @@ class BatchedPotential:
         self.last_stats["rebuild_count"] = int(not reused)
         self.last_stats["rebuild_on_device"] = int(refreshed)
         self.last_stats["rebuild_overflow_count"] = self.rebuild_overflow_count
+        # AOT executable cache (fleet/aot.install_aot_cache): whether this
+        # dispatch ran a rehydrated (deserialized) bucket executable
+        # instead of a JIT-compiled one
+        aot = getattr(self._potential, "last_dispatch_aot", None)
+        if aot is not None:
+            self.last_stats["aot_rehydrated"] = bool(aot)
         self.last_bucket_key = self.last_stats.get("bucket_key", "")
         # bucket-cached peak estimate (cache hits reuse the compile-time
         # calibration) + headroom against the device limit/budget — ONE
@@ -523,6 +529,8 @@ class BatchedPotential:
             else:
                 rec.extra[k] = v
         rec.batch_size = n_structures  # real structures, not padded slots
+        rec.aot_rehydrated = bool(self.last_stats.get("aot_rehydrated",
+                                                      False))
         tel.emit(rec)
 
 

@@ -1,0 +1,306 @@
+"""AOT executable cache: restart a replica with ZERO recompiles.
+
+At production scale cold-start compiles ARE the outage: a restarted
+replica that has to re-trace and re-compile its whole bucket ladder
+serves nothing for minutes (the export/AOT discipline of arXiv
+2504.16068 is the pattern this module reproduces). So every bucket
+executable a :class:`~distmlip_tpu.calculators.batched.BatchedPotential`
+compiles is serialized to disk via ``jax.export`` and rehydrated by the
+next replica that needs the same bucket:
+
+- **key** = ``(bucket_key, model fingerprint, capacity-ladder
+  fingerprint, jax version + backend)``. The bucket key pins the padded
+  shapes, the model fingerprint pins the traced program (config + param
+  tree structure/shapes/dtypes — NOT param values, which are runtime
+  inputs), the ladder fingerprint (``BucketPolicy.fingerprint``) pins the
+  quantization that produced the shapes, and the jax/backend pair pins
+  the StableHLO dialect + target. ANY mismatch is a clean miss.
+- **rehydrate** (:func:`install_aot_cache`): the potential's jitted
+  callable is wrapped by a dispatcher that serves a cached bucket through
+  the deserialized executable — the jit NEVER traces, so
+  ``BatchedPotential.compile_count`` stays 0 (the cold-start acceptance
+  gate) — and falls back to the normal JIT transparently on a miss,
+  a corrupt entry, or a call-time mismatch (stale pytree layout).
+- **save**: after a fresh JIT compile of a new bucket, the program is
+  exported (``jit.lower`` — an abstract trace, no second device compile)
+  and written atomically. Best-effort: an export failure never fails the
+  batch (mesh-sharded programs, for example, may not serialize on every
+  jax build — they simply stay JIT-only).
+
+Numerics: the deserialized executable runs the SAME StableHLO the JIT
+path compiles, so rehydrated results are fp-identical to a cold compile
+on the same backend (pinned by tests/test_fleet_cache.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+
+
+_EXPORT_REGISTERED = False
+
+
+def _ensure_export_registrations() -> None:
+    """Teach jax.export to serialize the PartitionedGraph pytree node.
+
+    ``register_dataclass`` flattens the graph with its meta fields as a
+    flat auxdata tuple — (num_partitions, shifts, has_bond_graph, n_cap,
+    e_cap, b_cap, e_split, batch_size, spatial_parts) — which encodes to
+    JSON directly; only ``shifts`` needs its tuple-ness restored on the
+    way back (pytree auxdata equality is by value AND type)."""
+    global _EXPORT_REGISTERED
+    if _EXPORT_REGISTERED:
+        return
+    from jax import export as jax_export
+
+    from ..partition.graph import PartitionedGraph
+
+    def _ser(aux) -> bytes:
+        return json.dumps(list(aux)).encode()
+
+    def _des(data: bytes):
+        aux = json.loads(data.decode())
+        aux[1] = tuple(aux[1])  # shifts
+        return tuple(aux)
+
+    try:
+        jax_export.register_pytree_node_serialization(
+            PartitionedGraph,
+            serialized_name="distmlip_tpu.partition.graph.PartitionedGraph",
+            serialize_auxdata=_ser, deserialize_auxdata=_des)
+    except ValueError:
+        pass  # already registered by another cache instance
+    _EXPORT_REGISTERED = True
+
+
+def model_fingerprint(model, params) -> str:
+    """Digest of everything that shapes the traced program besides the
+    packed graph: model class + config, and the param pytree's structure
+    with leaf shapes/dtypes (values are call arguments, not constants)."""
+    import jax
+
+    h = hashlib.sha256()
+    h.update(type(model).__name__.encode())
+    cfg = getattr(model, "cfg", None)
+    if cfg is not None:
+        for k, v in sorted(vars(cfg).items()):
+            h.update(f"{k}={v!r};".encode())
+    leaves, treedef = jax.tree.flatten(params)
+    h.update(str(treedef).encode())
+    for leaf in leaves:
+        h.update(f"{getattr(leaf, 'shape', ())}:"
+                 f"{getattr(leaf, 'dtype', type(leaf).__name__)};".encode())
+    return h.hexdigest()[:16]
+
+
+def backend_fingerprint() -> str:
+    import jax
+
+    return f"jax{jax.__version__}:{jax.default_backend()}"
+
+
+class AotExecutableCache:
+    """Disk cache of serialized bucket executables (one file per key).
+
+    ``fingerprint`` is the model digest (:func:`model_fingerprint`);
+    ``ladder`` the capacity-policy fingerprint. Counters: ``rehydrated``
+    (buckets served from disk), ``saved``, ``misses`` (bucket had no
+    usable entry), ``errors`` (corrupt/stale entries that fell back to
+    JIT)."""
+
+    def __init__(self, cache_dir: str, fingerprint: str = "",
+                 ladder: str = ""):
+        self.cache_dir = str(cache_dir)
+        self.fingerprint = fingerprint
+        self.ladder = ladder
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self.rehydrated = 0
+        self.saved = 0
+        self.misses = 0
+        self.errors = 0
+
+    @classmethod
+    def for_potential(cls, cache_dir: str, pot) -> "AotExecutableCache":
+        """Key the cache on a BatchedPotential's model/params/ladder."""
+        fp = getattr(pot.caps, "fingerprint", None)
+        return cls(cache_dir,
+                   fingerprint=model_fingerprint(pot.model, pot.params),
+                   ladder=fp() if fp is not None else "")
+
+    def entry_key(self, bucket_key: str) -> str:
+        raw = (f"{bucket_key}|{self.fingerprint}|{self.ladder}|"
+               f"{backend_fingerprint()}")
+        return hashlib.sha256(raw.encode()).hexdigest()[:32]
+
+    def _path(self, bucket_key: str) -> str:
+        return os.path.join(self.cache_dir,
+                            f"{self.entry_key(bucket_key)}.jaxexp")
+
+    def load(self, bucket_key: str) -> bytes | None:
+        try:
+            with open(self._path(bucket_key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def save(self, bucket_key: str, payload: bytes) -> None:
+        """Atomic write (tmp + rename) so a concurrently restarting
+        replica never deserializes a half-written entry."""
+        path = self._path(bucket_key)
+        fd, tmp = tempfile.mkstemp(dir=self.cache_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        # sidecar index line (human debugging: which bucket is which file)
+        try:
+            with open(os.path.join(self.cache_dir, "index.jsonl"), "a") as f:
+                f.write(json.dumps({"bucket": bucket_key,
+                                    "file": os.path.basename(path),
+                                    "model": self.fingerprint,
+                                    "ladder": self.ladder,
+                                    "backend": backend_fingerprint()}) + "\n")
+        except OSError:
+            pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"rehydrated": self.rehydrated, "saved": self.saved,
+                    "misses": self.misses, "errors": self.errors}
+
+
+class _AotDispatcher:
+    """Drop-in wrapper around a BatchedPotential's jitted callable.
+
+    Per call: resolve the packed graph's bucket key; serve from a
+    deserialized executable when the cache has the bucket (the wrapped
+    jit never traces — ``_cache_size`` stays 0), else run the jit and
+    export the freshly compiled bucket for the next restart.
+    ``last_dispatch_aot`` reports which path the LAST call took
+    (BatchedPotential plumbs it into ``last_stats``/telemetry as
+    ``aot_rehydrated``)."""
+
+    def __init__(self, jit_fn, cache: AotExecutableCache, save: bool = True):
+        self._jit = jit_fn
+        self._cache = cache
+        self._save = bool(save)
+        self._loaded: dict[str, object] = {}   # bucket_key -> jitted call
+        self._failed: set[str] = set()         # buckets proven unusable
+        self._saved: set[str] = set()          # buckets exported this run
+        self._lock = threading.Lock()
+        self.last_dispatch_aot = False
+
+    # BatchedPotential.compile_count reads this: only REAL jit traces
+    # count — a rehydrated bucket must keep the counter at zero
+    def _cache_size(self) -> int:
+        size_fn = getattr(self._jit, "_cache_size", None)
+        return int(size_fn()) if size_fn is not None else 0
+
+    def _rehydrate(self, key: str):
+        import jax
+        from jax import export as jax_export
+
+        _ensure_export_registrations()
+        data = self._cache.load(key)
+        if data is None:
+            with self._cache._lock:
+                self._cache.misses += 1
+            return None
+        try:
+            exp = jax_export.deserialize(data)
+            # jit the exported call so the StableHLO compiles once and
+            # subsequent batches of this bucket hit the executable
+            fn = jax.jit(exp.call)
+        except Exception:  # noqa: BLE001 - corrupt/stale entry: JIT wins
+            with self._cache._lock:
+                self._cache.errors += 1
+            return None
+        with self._cache._lock:
+            self._cache.rehydrated += 1
+        return fn
+
+    def __call__(self, params, graph, positions):
+        from ..partition.batch import bucket_key as _bucket_key
+
+        key = _bucket_key(graph)
+        with self._lock:
+            fn = self._loaded.get(key)
+            known_bad = key in self._failed
+        if fn is None and not known_bad:
+            fn = self._rehydrate(key)
+            with self._lock:
+                if fn is not None:
+                    self._loaded[key] = fn
+                else:
+                    self._failed.add(key)
+        if fn is not None:
+            try:
+                out = fn(params, graph, positions)
+                self.last_dispatch_aot = True
+                return out
+            except Exception:  # noqa: BLE001 - stale layout: fall back
+                with self._lock:
+                    self._loaded.pop(key, None)
+                    self._failed.add(key)
+                with self._cache._lock:
+                    self._cache.errors += 1
+        self.last_dispatch_aot = False
+        out = self._jit(params, graph, positions)
+        if self._save:
+            with self._lock:
+                fresh = key not in self._saved
+                self._saved.add(key)
+            if fresh:
+                self._export(key, params, graph, positions)
+        return out
+
+    def _export(self, key, params, graph, positions) -> None:
+        """Serialize the just-compiled bucket program (abstract re-trace,
+        no second device compile). Best-effort by contract."""
+        try:
+            from jax import export as jax_export
+
+            _ensure_export_registrations()
+            exp = jax_export.export(self._jit)(params, graph, positions)
+            self._cache.save(key, exp.serialize())
+            with self._cache._lock:
+                self._cache.saved += 1
+        except Exception:  # noqa: BLE001 - export must never fail a batch
+            pass
+
+
+def install_aot_cache(pot, cache: AotExecutableCache | str,
+                      save: bool = True):
+    """Wrap ``pot``'s jitted potential with the AOT dispatcher.
+
+    ``cache`` may be a ready :class:`AotExecutableCache` or a directory
+    path (keyed automatically via :meth:`AotExecutableCache.
+    for_potential`). Returns ``pot`` (mutated in place): its
+    ``compile_count`` keeps counting only real JIT traces, and
+    ``pot.aot_cache`` exposes the cache for stats/assertions.
+
+    Note: a bucket served purely from the AOT cache never runs the
+    static HBM calibration trace (that rides the fresh-compile path), so
+    a rehydrated replica's bytes model starts uncalibrated — identical
+    to a cold replica's first batch, and self-correcting on the first
+    genuinely new bucket."""
+    if not isinstance(cache, AotExecutableCache):
+        cache = AotExecutableCache.for_potential(str(cache), pot)
+    if isinstance(pot._potential, _AotDispatcher):   # idempotent
+        pot._potential._cache = cache
+        pot.aot_cache = cache
+        return pot
+    pot._potential = _AotDispatcher(pot._potential, cache, save=save)
+    pot.aot_cache = cache
+    return pot

@@ -145,6 +145,14 @@ class CapacityPolicy:
                 self._caps[name] = cap
             return cap
 
+    def fingerprint(self) -> str:
+        """Configuration id (see ``BucketPolicy.fingerprint``). Sticky
+        policies are history-DEPENDENT — two equal fingerprints only
+        guarantee shape agreement from a cold start — so AOT-cache
+        consumers should prefer the stateless ``BucketPolicy``; the
+        fingerprint still distinguishes slack/multiple retunes."""
+        return f"sticky:s{self.slack:.6g}:m{self.multiple}"
+
 
 class BucketPolicy:
     """Stateless geometric capacity ladder (see module docstring).
@@ -181,6 +189,17 @@ class BucketPolicy:
 
     def get(self, name: str, needed: int) -> int:
         return geometric_bucket(needed, self.base, self.growth, self.multiple)
+
+    def fingerprint(self) -> str:
+        """Stable id of the LADDER CONFIGURATION (not its state): two
+        policies with equal fingerprints quantize every request onto
+        identical capacity rungs, so a compiled executable keyed on a
+        ``bucket_key`` under one policy is exactly reusable under the
+        other. The fleet's AOT executable cache folds this into its disk
+        key — a retuned ladder (different base/growth/multiple) changes
+        every padded shape and must miss, not deserialize a stale
+        program."""
+        return f"bucket:b{self.base}:g{self.growth:.6g}:m{self.multiple}"
 
     # ---- bytes-per-structure model (memory-aware autobatching) ----
 

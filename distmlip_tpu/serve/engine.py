@@ -28,7 +28,9 @@ Robustness contract (tests/test_serve.py):
   of the batch returns results and the engine thread survives;
 - ``drain()`` flushes everything in flight deterministically and returns
   with the queue empty and every Future resolved; ``close()`` drains by
-  default, then joins the scheduler thread;
+  default, then joins the scheduler thread; ``extract_pending()`` is the
+  fleet router's handoff hook — it reclaims the queued requests (Futures
+  UNRESOLVED) for re-dispatch on another replica instead of failing them;
 - the scheduler thread can never die: every execution path is wrapped so
   an unexpected failure resolves the affected Futures exceptionally and
   the loop continues.
@@ -213,6 +215,9 @@ class ServeEngine:
         self._draining = 0
         self._closed = False     # submit() gate
         self._closing = False    # scheduler exit signal
+        # last time the scheduler completed a dispatch round (or had an
+        # empty queue) — the wedge-detection signal health_snapshot serves
+        self._last_progress = self._clock()
         self._step = 0
         self._thread: threading.Thread | None = None
         if start:
@@ -245,6 +250,54 @@ class ServeEngine:
         fake clock past the max-wait deadline)."""
         with self._cv:
             self._cv.notify_all()
+
+    def extract_pending(self) -> list:
+        """Reclaim every NOT-YET-DISPATCHED request for re-dispatch
+        elsewhere (the fleet router's drain-and-handoff path).
+
+        Atomically pops the whole queue and returns the ``_Request``
+        objects in dispatch (priority/deadline/FIFO) order — each carries
+        ``atoms``, ``properties``, ``priority``, ``deadline_abs``,
+        ``t_submit`` and its UNRESOLVED ``future``. The engine stops
+        accepting new submits (as if closed); in-flight batches still
+        complete and resolve their own Futures. Unlike
+        ``close(drain=False)``, nothing returned here is failed with
+        ``EngineClosed`` — the caller owns re-dispatching (or failing)
+        the reclaimed requests, so no submitted Future is ever lost to a
+        replica handoff."""
+        with self._cv:
+            self._closed = True     # no new submits race the handoff
+            reqs = []
+            while self._pending:
+                reqs.append(heapq.heappop(self._pending))
+            # blocked admission waiters observe _closed and raise
+            self._cv.notify_all()
+        return reqs
+
+    @property
+    def scheduler_alive(self) -> bool:
+        """The scheduler thread exists and is still serving (a dead
+        thread strands Futures and blocks drain forever)."""
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def health_snapshot(self) -> dict:
+        """One consistent health sample for a replica monitor: queue
+        depth, in-flight batches, liveness, and how long ago the
+        scheduler last made dispatch progress (on the engine clock). A
+        wedged engine shows ``queue_depth > 0`` (or in-flight work) with
+        an ever-growing ``last_progress_age_s`` while
+        ``scheduler_alive`` stays True — the BENCH_r03 signature, visible
+        without touching the device."""
+        with self._cv:
+            return {
+                "queue_depth": len(self._pending),
+                "inflight": self._inflight,
+                "scheduler_alive": self.scheduler_alive,
+                "last_progress_age_s": self._clock() - self._last_progress,
+                "completed": self.stats.completed,
+                "failed": self.stats.failed,
+            }
 
     def drain(self, timeout: float | None = None) -> bool:
         """Flush: dispatch everything queued (bypassing max-wait) and wait
@@ -407,6 +460,7 @@ class ServeEngine:
         while True:
             with self._cv:
                 while not self._pending and not self._closing:
+                    self._last_progress = self._clock()  # idle = healthy
                     self._cv.wait(timeout=0.05)
                 if not self._pending and self._closing:
                     return
@@ -433,6 +487,7 @@ class ServeEngine:
             finally:
                 with self._cv:
                     self._inflight -= 1
+                    self._last_progress = self._clock()
                     self._cv.notify_all()
 
     def _assemble_locked(self):
@@ -691,7 +746,7 @@ class ServeEngine:
                   "num_partitions", "n_cap", "e_cap",
                   "mesh_shape", "spatial_parts", "batch_parts",
                   "halo_send_per_part", "kernel_mode", "kernel_coverage",
-                  "est_peak_bytes", "hbm_headroom_frac"):
+                  "est_peak_bytes", "hbm_headroom_frac", "aot_rehydrated"):
             if pot_stats and k in pot_stats:
                 setattr(rec, k, pot_stats[k])
         tel.emit(rec)

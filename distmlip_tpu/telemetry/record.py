@@ -77,6 +77,14 @@ class StepRecord:
     reject_count: int = 0            # cumulative admission rejects at emit
     deadline_miss_count: int = 0     # cumulative deadline misses at emit
 
+    # --- serving fleet (fleet/router.py; kind fleet_request) ---
+    tenant: str = ""                 # submitting tenant ("" = unattributed)
+    replica_id: str = ""             # replica that served it ("" = no chip:
+    #                                  cache hit, or failed pre-dispatch)
+    cache_hit: bool = False          # served from the content-addressed cache
+    aot_rehydrated: bool = False     # executable came from the AOT cache
+    #                                  (no JIT trace/compile on this replica)
+
     # --- halo pipeline + device-program cost model ---
     halo_mode: str = ""              # coalesced | legacy ("" = unknown)
     collective_count: int = 0        # collectives in the traced step program

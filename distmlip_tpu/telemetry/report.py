@@ -161,6 +161,27 @@ class Report:
                 f"  rejects={s['rejects']} "
                 f"deadline_misses={s['deadline_misses']} "
                 f"fallback_batches={s['fallback_batches']}")
+        if c.get("fleet"):
+            fl = c["fleet"]
+            out.append("")
+            out.append("fleet (FleetRouter):")
+            out.append(
+                f"  requests={fl['requests']} "
+                f"cache_hit_rate={fl['cache_hit_rate']:.2f} "
+                f"coalesced={fl['coalesced']} "
+                f"failovers={fl['failovers']} "
+                f"redispatches={fl['redispatches']} "
+                f"aot_rehydrated_steps={fl['aot_rehydrated_steps']}")
+            for name, t in fl["tenants"].items():
+                out.append(
+                    f"  tenant {name:<16} n={t['requests']:<6d} "
+                    f"latency_ms p50={1e3 * t['latency_p50_s']:.1f} "
+                    f"p95={1e3 * t['latency_p95_s']:.1f} "
+                    f"p99={1e3 * t['latency_p99_s']:.1f}")
+            share = " ".join(f"{rid}={frac:.2f}"
+                             for rid, frac in fl["replica_share"].items())
+            if share:
+                out.append(f"  replica load share: {share}")
         if c.get("training"):
             t = c["training"]
             out.append("")
@@ -414,6 +435,93 @@ def aggregate(
             "rejects": max(r.reject_count for r in serve),
             "deadline_misses": max(r.deadline_miss_count for r in serve),
         }
+
+    # --- serving fleet: per-tenant tails, per-replica load, cache ---
+    fleet = [r for r in records if r.kind == "fleet_request"]
+    if fleet:
+        def _get(r, name, default=0):
+            return r.extra.get(name, default) if r.extra else default
+
+        by_tenant: dict[str, list[float]] = {}
+        by_replica: dict[str, int] = {}
+        for r in fleet:
+            name = r.tenant or "(unattributed)"
+            by_tenant.setdefault(name, []).extend(r.request_latency_s)
+            if r.replica_id:
+                by_replica[r.replica_id] = (by_replica.get(r.replica_id, 0)
+                                            + max(r.batch_size, 1))
+        dispatched = sum(by_replica.values())
+        tenants = {}
+        for name, lats in sorted(by_tenant.items()):
+            lats = sorted(lats)
+            tenants[name] = {
+                "requests": len(lats),
+                "latency_p50_s": percentile(lats, 0.50),
+                "latency_p95_s": percentile(lats, 0.95),
+                "latency_p99_s": percentile(lats, 0.99),
+            }
+        hits = sum(bool(r.cache_hit) for r in fleet)
+        # AOT-rehydrated dispatches: count ONE kind, preferring the one
+        # closest to the actual dispatch — a rehydrated batch serving 8
+        # requests emits the flag on its batched_calculate record AND its
+        # serve_batch record; summing across kinds would multi-count it
+        aot = 0
+        for kinds in (("batched_calculate",),
+                      ("serve_batch", "serve_fallback"),
+                      ("fleet_request",)):
+            sel = [r for r in records if r.kind in kinds]
+            if sel:
+                aot = sum(bool(r.aot_rehydrated) for r in sel)
+                break
+        c["fleet"] = {
+            "requests": sum(max(r.batch_size, 1) for r in fleet),
+            "tenants": tenants,
+            "replica_share": {rid: n / max(dispatched, 1)
+                              for rid, n in sorted(by_replica.items())},
+            "cache_hit_rate": hits / len(fleet),
+            "cache_evictions": max(_get(r, "cache_evictions")
+                                   for r in fleet),
+            "coalesced": max(_get(r, "coalesced_count") for r in fleet),
+            "failovers": max(_get(r, "failover_count") for r in fleet),
+            "redispatches": max(_get(r, "redispatch_count")
+                                for r in fleet),
+            "aot_rehydrated_steps": aot,
+        }
+        # replica load skew: with >= 2 replicas actually serving, one
+        # replica carrying more than imbalance_factor x the OTHERS' mean
+        # load means least-loaded routing is defeated (a replica is
+        # slow-serving, or the others are flapping). Measured against
+        # the others — max/overall-mean saturates at N on N replicas and
+        # could never flag a 2-replica fleet.
+        # (suppressed on runs with failovers: a killed replica's traffic
+        # legitimately piles onto the survivors)
+        if len(by_replica) >= 2 and dispatched >= 8 \
+                and c["fleet"]["failovers"] == 0:
+            worst_rid = max(by_replica, key=by_replica.get)
+            others = dispatched - by_replica[worst_rid]
+            mean_others = others / (len(by_replica) - 1)
+            skew = (by_replica[worst_rid] / mean_others
+                    if mean_others > 0 else float("inf"))
+            if skew > imbalance_factor:
+                rep.anomalies.append(Anomaly(
+                    "replica_load_skew", 0,
+                    f"replica {worst_rid} served {skew:.2f}x the mean "
+                    f"load share (> {imbalance_factor:.1f}) over "
+                    f"{dispatched} dispatched request(s) — check replica "
+                    f"health / outstanding caps"))
+        # cache thrash: the byte bound is evicting entries faster than
+        # the stream re-uses them — the cache burns memory and copies
+        # without serving hits; grow max_bytes or stop caching this
+        # workload
+        evictions = c["fleet"]["cache_evictions"]
+        if (len(fleet) >= 20 and evictions > len(fleet)
+                and c["fleet"]["cache_hit_rate"] < 0.05):
+            rep.anomalies.append(Anomaly(
+                "cache_thrash", 0,
+                f"{evictions} eviction(s) against "
+                f"{c['fleet']['cache_hit_rate']:.1%} hit rate over "
+                f"{len(fleet)} request(s) — the result cache's byte bound "
+                f"is far below the working set"))
 
     # --- training loop: loss trajectory + optimizer dynamics ---
     train = [r for r in records if r.kind == "train_step"]

@@ -1,0 +1,492 @@
+"""Serving fleet: router, tenancy, failover, health monitor, telemetry.
+
+The chaos acceptance contract under test: a replica killed mid-flight
+loses ZERO submitted requests — every Future resolves with a result or
+an explicit per-request error — while surviving replicas absorb the
+failover; duplicate traffic is served from the content-addressed cache
+with NO replica dispatch; and the ServeEngine handoff hook
+(``extract_pending``) reclaims queued requests with their Futures
+unresolved.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import Atoms, BatchedPotential, DistPotential
+from distmlip_tpu.fleet import (FleetRouter, Replica, ReplicaHealth,
+                                ResultCache, TenantConfig, TokenBucket)
+from distmlip_tpu.fleet.tenancy import FairScheduler
+from distmlip_tpu.models import PairConfig, PairPotential
+from distmlip_tpu.partition import BucketPolicy
+from distmlip_tpu.serve import EngineClosed, ServeEngine, ServeRejected
+from distmlip_tpu.telemetry import StepRecord, Telemetry
+from distmlip_tpu.utils.health import ReprobePolicy
+
+pytestmark = pytest.mark.fleet
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def pair():
+    model = PairPotential(PairConfig(cutoff=4.0))
+    return model, model.init()
+
+
+def make_structure(rng, noise=0.05):
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5],
+                     [0, 0.5, 0.5]])
+    frac, lat = geometry.make_supercell(unit, np.eye(3) * 3.6, (2, 2, 2))
+    cart = geometry.frac_to_cart(frac, lat) + rng.normal(
+        0, noise, (len(frac), 3))
+    return Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lat)
+
+
+def make_engine(pair, **kw):
+    model, params = pair
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_s", 0.005)
+    kw.setdefault("max_queue", 4096)
+    return ServeEngine(BatchedPotential(model, params, caps=BucketPolicy()),
+                      **kw)
+
+
+# ---------------------------------------------------------------------------
+# tenancy primitives
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_token_bucket_rate_and_burst():
+    clock = FakeClock()
+    tb = TokenBucket(rate_hz=10.0, burst=3.0, clock=clock)
+    assert [tb.take() for _ in range(4)] == [True, True, True, False]
+    clock.advance(0.1)          # refills exactly one token
+    assert tb.take() and not tb.take()
+    clock.advance(100.0)        # refill clamps at burst
+    assert [tb.take() for _ in range(4)] == [True, True, True, False]
+
+
+@pytest.mark.tier1
+def test_fair_scheduler_weighted_interleave():
+    s = FairScheduler(clock=FakeClock())
+    s.configure("heavy", TenantConfig(weight=3.0))
+    s.configure("light", TenantConfig(weight=1.0))
+    for i in range(40):
+        s.enqueue("heavy", f"h{i}")
+        s.enqueue("light", f"l{i}")
+    first16 = [s.pop()[0] for _ in range(16)]
+    # 3:1 stride share under contention
+    assert first16.count("heavy") == 12
+    assert first16.count("light") == 4
+    # no starvation: light is served within every rotation
+    assert "light" in first16[:4]
+
+
+@pytest.mark.tier1
+def test_fair_scheduler_idle_tenant_banks_no_credit():
+    s = FairScheduler(clock=FakeClock())
+    s.configure("busy", TenantConfig(weight=1.0))
+    s.configure("sleepy", TenantConfig(weight=1.0))
+    for i in range(50):
+        s.enqueue("busy", i)
+    for _ in range(50):
+        s.pop()
+    # sleepy wakes after busy dispatched 50: it must NOT get 50 back-to-
+    # back dispatches — its pass clamps to the current virtual time
+    for i in range(4):
+        s.enqueue("sleepy", f"s{i}")
+        s.enqueue("busy", f"b{i}")
+    order = [s.pop()[0] for _ in range(8)]
+    assert order.count("sleepy") == 4
+    assert order[:2] != ["sleepy", "sleepy"] or order[2] == "busy"
+
+
+@pytest.mark.tier1
+def test_fair_scheduler_front_requeue_preserves_head():
+    s = FairScheduler(clock=FakeClock())
+    s.enqueue("t", "first")
+    s.enqueue("t", "second")
+    name, item = s.pop()
+    assert item == "first"
+    s.enqueue("t", item, front=True)    # failover reclaim
+    assert s.pop()[1] == "first"        # keeps its place, no penalty
+    assert s.pop()[1] == "second"
+
+
+@pytest.mark.tier1
+def test_reprobe_policy_bounded_confirmation():
+    clock = FakeClock()
+    pol = ReprobePolicy(max_reprobes=1, backoff_s=1.0, clock=clock)
+    assert pol.observe(False) == "suspect"
+    # inside the backoff window the verdict stands (no burned re-probe)
+    assert pol.observe(False) == "suspect"
+    clock.advance(1.5)
+    assert pol.observe(False) == "wedged"
+    pol.reset()
+    assert pol.observe(True) == "healthy"
+    assert pol.observe(False) == "suspect"
+    clock.advance(1.5)
+    assert pol.observe(True) == "healthy"   # recovery clears suspicion
+    assert pol.failures == 0
+
+
+# ---------------------------------------------------------------------------
+# engine handoff hook
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_extract_pending_reclaims_unresolved_futures(rng, pair):
+    engine = make_engine(pair, start=False)     # staged: nothing dispatches
+    futs = [engine.submit(make_structure(rng), priority=p)
+            for p in (1, 0, 2)]
+    reqs = engine.extract_pending()
+    assert [r.priority for r in reqs] == [0, 1, 2]   # dispatch order
+    assert engine.queue_depth == 0
+    for r, f in zip(reqs, futs):
+        assert not r.future.done()      # NOT failed, unlike close(drain=0)
+    assert {r.future for r in reqs} == set(futs)
+    with pytest.raises(EngineClosed):
+        engine.submit(make_structure(rng))      # handoff closes the door
+    engine.close()
+
+
+@pytest.mark.tier1
+def test_health_snapshot_reports_progress_and_liveness(rng, pair):
+    clock = FakeClock()
+    engine = make_engine(pair, start=False, clock=clock)
+    snap = engine.health_snapshot()
+    assert snap["scheduler_alive"] is False
+    engine.submit(make_structure(rng))
+    clock.advance(42.0)
+    snap = engine.health_snapshot()
+    assert snap["queue_depth"] == 1
+    assert snap["last_progress_age_s"] >= 42.0
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# router: routing, parity, caching, quotas
+# ---------------------------------------------------------------------------
+
+
+def test_router_roundtrip_parity_and_least_loaded(rng, pair):
+    model, params = pair
+    router = FleetRouter([make_engine(pair) for _ in range(2)],
+                         result_cache=ResultCache(), model_id="pair")
+    structs = [make_structure(rng) for _ in range(12)]
+    futs = [router.submit(a) for a in structs]
+    results = [f.result(timeout=60) for f in futs]
+    ref_pot = DistPotential(model, params, num_partitions=1)
+    ref = ref_pot.calculate(structs[0])
+    np.testing.assert_allclose(results[0]["energy"], ref["energy"],
+                               rtol=5e-6, atol=1e-7)
+    np.testing.assert_allclose(results[0]["forces"], ref["forces"],
+                               rtol=5e-5, atol=1e-5)
+    snap = router.snapshot()
+    # both replicas served (least-loaded spreads a 12-request burst)
+    assert all(r["dispatched_total"] > 0
+               for r in snap["replicas"].values())
+    router.close()
+
+
+def test_router_cache_hits_perform_no_dispatch(rng, pair):
+    router = FleetRouter([make_engine(pair)], result_cache=ResultCache(),
+                         model_id="pair")
+    a = make_structure(rng)
+    ref = router.submit(a).result(timeout=60)
+    router.drain(timeout=60)
+    disp_before = router.snapshot()["replicas"]["r0"]["dispatched_total"]
+    eng_submitted_before = \
+        router.replicas["r0"].engine.stats.submitted
+    futs = [router.submit(a.copy()) for _ in range(20)]
+    for f in futs:
+        got = f.result(timeout=60)
+        assert got["energy"] == ref["energy"]           # fp-identical
+        assert np.array_equal(got["forces"], ref["forces"])
+    snap = router.snapshot()
+    assert snap["stats"]["cache_hits"] == 20
+    # the cache gate: hits touch NO chip — engine counters pinned
+    assert snap["replicas"]["r0"]["dispatched_total"] == disp_before
+    assert router.replicas["r0"].engine.stats.submitted == \
+        eng_submitted_before
+    assert router.cache.hit_rate() >= 0.9
+    router.close()
+
+
+def test_router_coalesces_identical_inflight(rng, pair):
+    engine = make_engine(pair, start=False)     # stage: nothing dispatches
+    router = FleetRouter([engine], result_cache=ResultCache(),
+                         model_id="pair")
+    a = make_structure(rng)
+    futs = [router.submit(a.copy()) for _ in range(5)]
+    assert router.stats.coalesced == 4          # one computation in flight
+    engine.start()
+    results = [f.result(timeout=60) for f in futs]
+    assert len({r["energy"] for r in results}) == 1
+    # coalesced callers get INDEPENDENT arrays (mutation safety)
+    for other in results[1:]:
+        assert not np.shares_memory(results[0]["forces"], other["forces"])
+    router.close()
+
+
+def test_tenant_quota_rejects_over_rate(rng, pair):
+    clock = FakeClock()
+    router = FleetRouter(
+        [make_engine(pair)],
+        tenants={"firehose": TenantConfig(weight=1.0, rate_hz=10.0,
+                                          burst=3.0)},
+        clock=clock)
+    a = make_structure(rng)
+    futs = [router.submit(a.copy(), tenant="firehose") for _ in range(3)]
+    with pytest.raises(ServeRejected):
+        router.submit(a.copy(), tenant="firehose")
+    # unmetered tenants are unaffected by the firehose's empty bucket
+    ok = router.submit(a.copy(), tenant="interactive")
+    for f in futs + [ok]:
+        f.result(timeout=60)
+    assert router.stats.quota_rejected == 1
+    assert router.snapshot()["tenants"]["firehose"]["quota_rejects"] == 1
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# failover / chaos
+# ---------------------------------------------------------------------------
+
+
+def test_kill_replica_mid_burst_loses_zero_requests(rng, pair):
+    router = FleetRouter([make_engine(pair) for _ in range(2)],
+                         result_cache=ResultCache(), model_id="pair")
+    structs = [make_structure(rng) for _ in range(30)]
+    futs = [router.submit(a) for a in structs[:15]]
+    moved = router.kill_replica("r0")
+    futs += [router.submit(a) for a in structs[15:]]
+    # the chaos contract: EVERY submitted Future resolves with a result
+    results = [f.result(timeout=120) for f in futs]
+    assert len(results) == 30
+    assert all("energy" in r and "forces" in r for r in results)
+    snap = router.snapshot()
+    assert snap["stats"]["failovers"] == 1
+    assert snap["stats"]["failed"] == 0
+    assert not snap["replicas"]["r0"]["alive"]
+    # post-kill traffic lands on the survivor
+    assert snap["replicas"]["r1"]["dispatched_total"] >= 15 + moved - 5
+    router.close()
+
+
+def test_failover_of_wedged_replica_redispatches_queued(rng, pair):
+    # r0 "wedges": its scheduler never starts, so submissions queue
+    # forever; fail_over must reclaim them onto r1 with Futures intact
+    wedged = make_engine(pair, start=False)
+    healthy = make_engine(pair)
+    router = FleetRouter([Replica(wedged, "r0"), Replica(healthy, "r1")],
+                         max_outstanding=4)
+    futs = [router.submit(make_structure(rng)) for _ in range(8)]
+    time.sleep(0.2)     # let dispatches land on both replicas
+    moved = router.fail_over("r0", reason="test wedge")
+    assert moved >= 1
+    for f in futs:
+        assert "energy" in f.result(timeout=120)
+    assert router.stats.redispatches >= moved
+    router.close()
+    wedged.close()
+
+
+def test_health_monitor_confirms_wedge_and_fails_over(rng, pair):
+    clock = FakeClock()
+    wedged = make_engine(pair, start=False, clock=clock)  # thread dead
+    healthy = make_engine(pair)
+    router = FleetRouter([Replica(wedged, "r0"), Replica(healthy, "r1")],
+                         max_outstanding=4)
+    monitor = ReplicaHealth(router, stall_budget_s=30.0, max_reprobes=1,
+                            backoff_s=1.0, clock=clock)
+    futs = [router.submit(make_structure(rng)) for _ in range(6)]
+    time.sleep(0.2)
+    v1 = monitor.poll_once()
+    assert v1["r0"] == "suspect"        # first failure: suspicion only
+    assert v1["r1"] == "healthy"
+    assert router.replicas["r0"].alive  # NOT failed over yet
+    clock.advance(2.0)                  # past the re-probe backoff
+    v2 = monitor.poll_once()
+    assert v2["r0"] == "wedged"
+    assert not router.replicas["r0"].alive
+    assert monitor.failovers == 1
+    for f in futs:                      # zero requests lost to the wedge
+        assert "energy" in f.result(timeout=120)
+    assert monitor.poll_once()["r0"] == "dead"      # no double failover
+    assert router.stats.failovers == 1
+    monitor.close()
+    router.close()
+    wedged.close()
+
+
+def test_health_monitor_never_kills_last_alive_replica(rng, pair):
+    # a confirmed wedge on the ONLY alive replica is reported but NOT
+    # auto-failed-over: converting "slow" (e.g. a cold-start compile
+    # making no dispatch progress) into a total outage is worse than
+    # waiting — router.fail_over stays available as an operator action
+    clock = FakeClock()
+    wedged = make_engine(pair, start=False, clock=clock)
+    router = FleetRouter([Replica(wedged, "r0")])
+    monitor = ReplicaHealth(router, stall_budget_s=30.0, max_reprobes=1,
+                            backoff_s=1.0, clock=clock)
+    assert monitor.poll_once()["r0"] == "suspect"
+    clock.advance(2.0)
+    assert monitor.poll_once()["r0"] == "wedged"    # reported...
+    assert router.replicas["r0"].alive              # ...but left alive
+    assert monitor.failovers == 0
+    monitor.close()
+    router.close(drain=False)
+    wedged.close()
+
+
+def test_all_replicas_dead_fails_futures_explicitly(rng, pair):
+    engine = make_engine(pair, start=False)
+    router = FleetRouter([engine])
+    futs = [router.submit(make_structure(rng)) for _ in range(3)]
+    router.fail_over("r0", reason="test")
+    from distmlip_tpu.fleet import FleetError
+
+    for f in futs:      # resolved with an EXPLICIT error — never lost
+        with pytest.raises(FleetError):
+            f.result(timeout=30)
+    router.close()
+    engine.close()
+
+
+def test_router_close_and_lifecycle(rng, pair):
+    router = FleetRouter([make_engine(pair)])
+    f = router.submit(make_structure(rng))
+    router.close()
+    assert f.done()
+    with pytest.raises(EngineClosed):
+        router.submit(make_structure(rng))
+    router.close()      # idempotent
+
+
+def test_load_test_fleet_chaos_cli_gate():
+    """The ROADMAP acceptance gate: tools/load_test.py --fleet 2 --chaos
+    kill-replica --check exits 0 with every check green (zero lost
+    requests, bounded p99, compile bound, cache hit floor with no
+    dispatch)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "load_test.py"),
+         "--fleet", "2", "--chaos", "kill-replica", "--requests", "32",
+         "--check"],
+        cwd=repo, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["check"] == "ok"
+    assert summary["checks"]["zero_lost"]
+    assert summary["checks"]["failover_observed"]
+    assert summary["checks"]["no_dispatch_on_hits"]
+
+
+# ---------------------------------------------------------------------------
+# telemetry: fleet records + report section + anomalies
+# ---------------------------------------------------------------------------
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+    def close(self):
+        pass
+
+
+def test_fleet_records_carry_tenant_replica_cache_fields(rng, pair):
+    sink = _ListSink()
+    router = FleetRouter([make_engine(pair)],
+                         result_cache=ResultCache(), model_id="pair",
+                         telemetry=Telemetry([sink]))
+    a = make_structure(rng)
+    router.submit(a, tenant="vip").result(timeout=60)
+    router.submit(a.copy(), tenant="vip").result(timeout=60)   # cache hit
+    router.close()
+    fleet = [r for r in sink.records if r.kind == "fleet_request"]
+    assert len(fleet) == 2
+    served, hit = fleet
+    assert served.tenant == "vip" and served.replica_id == "r0"
+    assert served.cache_hit is False
+    assert hit.cache_hit is True and hit.replica_id == ""
+    assert all(len(r.request_latency_s) >= 1 for r in fleet)
+
+
+def _fleet_record(step, tenant, replica_id, lat, cache_hit=False,
+                  extra=None):
+    return StepRecord(step=step, kind="fleet_request", tenant=tenant,
+                      replica_id=replica_id, cache_hit=cache_hit,
+                      batch_size=1, request_latency_s=[lat],
+                      timings={"total_s": lat}, extra=dict(extra or {}))
+
+
+def test_report_fleet_section_and_load_skew_anomaly():
+    from distmlip_tpu.telemetry.report import aggregate
+
+    records = []
+    for i in range(30):
+        rid = "r0" if i < 27 else "r1"      # 9x the others' mean load
+        records.append(_fleet_record(
+            i, "screening" if i % 2 else "interactive", rid,
+            0.01 * (1 + i % 5)))
+    rep = aggregate(records)
+    fl = rep.counters["fleet"]
+    assert fl["requests"] == 30
+    assert set(fl["tenants"]) == {"interactive", "screening"}
+    assert fl["replica_share"]["r0"] > 0.8
+    assert any(a.kind == "replica_load_skew" for a in rep.anomalies)
+    assert "fleet (FleetRouter):" in rep.render()
+    # the same skew is EXPECTED after a failover (survivors absorb the
+    # dead replica's share): suppressed, not flagged
+    with_failover = [_fleet_record(i, "t", "r0" if i < 27 else "r1",
+                                   0.01, extra={"failover_count": 1})
+                     for i in range(30)]
+    rep_fo = aggregate(with_failover)
+    assert rep_fo.counters["fleet"]["failovers"] == 1
+    assert not any(a.kind == "replica_load_skew"
+                   for a in rep_fo.anomalies)
+
+
+def test_report_cache_thrash_anomaly_and_clean_fleet():
+    from distmlip_tpu.telemetry.report import aggregate
+
+    thrash = [_fleet_record(i, "t", "r0", 0.01,
+                            extra={"cache_evictions": 100})
+              for i in range(25)]
+    rep = aggregate(thrash)
+    assert any(a.kind == "cache_thrash" for a in rep.anomalies)
+    # balanced two-replica run with hits: clean
+    clean = []
+    for i in range(24):
+        clean.append(_fleet_record(i, "t", f"r{i % 2}", 0.01,
+                                   cache_hit=(i % 3 == 0)))
+    rep2 = aggregate(clean)
+    kinds = {a.kind for a in rep2.anomalies}
+    assert "replica_load_skew" not in kinds
+    assert "cache_thrash" not in kinds
+    assert rep2.counters["fleet"]["cache_hit_rate"] > 0.2

@@ -1,0 +1,273 @@
+"""Fleet caches: content-addressed result cache + AOT executable cache.
+
+Result-cache contract (ISSUE 12): hash stability across atom reorder and
+position wrapping, tolerance-bucket boundary semantics, LRU eviction at
+the byte bound, copy-on-return mutation safety, and the
+property-mismatch miss (an energy-only entry must never serve a forces
+request). AOT contract: a fresh potential rehydrating from a warm cache
+serves with ``compile_count == 0`` and fp-identical results; stale keys
+and corrupt entries fall back to JIT transparently.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import Atoms, BatchedPotential
+from distmlip_tpu.fleet import (AotExecutableCache, ResultCache, cache_key,
+                                install_aot_cache, structure_key)
+from distmlip_tpu.models import PairConfig, PairPotential
+from distmlip_tpu.partition import BucketPolicy
+
+pytestmark = pytest.mark.fleet
+
+TOL = 1e-4
+
+
+def make_atoms(rng, n=16, box=7.0, numbers=None):
+    lattice = np.eye(3) * box
+    # bucket-center positions: quantization-safe for the tolerance tests
+    frac = (rng.integers(1, 1000, (n, 3)) + 0.0) / 1000.0
+    cart = frac @ lattice
+    cart = np.round(cart / TOL) * TOL   # exact bucket centers
+    numbers = numbers if numbers is not None else \
+        rng.integers(1, 30, n).astype(np.int32)
+    return Atoms(numbers=numbers, positions=cart, cell=lattice)
+
+
+# ---------------------------------------------------------------------------
+# structure hashing
+# ---------------------------------------------------------------------------
+
+
+def test_structure_key_stable_across_atom_reorder(rng):
+    a = make_atoms(rng)
+    perm = rng.permutation(len(a))
+    b = Atoms(numbers=a.numbers[perm], positions=a.positions[perm],
+              cell=a.cell, pbc=a.pbc)
+    assert structure_key(a, tol=TOL) == structure_key(b, tol=TOL)
+
+
+def test_structure_key_stable_across_wrapped_positions(rng):
+    a = make_atoms(rng)
+    b = a.copy()
+    # translate half the atoms by whole lattice vectors: same structure
+    shifts = rng.integers(-2, 3, (len(a), 3)).astype(np.float64)
+    shifts[: len(a) // 2] = 0.0
+    b.positions = b.positions + shifts @ b.cell
+    assert structure_key(a, tol=TOL) == structure_key(b, tol=TOL)
+
+
+def test_structure_key_tolerance_bucket_boundaries(rng):
+    a = make_atoms(rng)
+    # well inside the bucket (quantization is round(x / tol)): identical
+    b = a.copy()
+    b.positions = b.positions + 0.2 * TOL
+    assert structure_key(a, tol=TOL) == structure_key(b, tol=TOL)
+    # a full bucket away: a DIFFERENT structure by contract
+    c = a.copy()
+    c.positions = c.positions.copy()
+    c.positions[0, 0] += 2.0 * TOL
+    assert structure_key(a, tol=TOL) != structure_key(c, tol=TOL)
+
+
+def test_structure_key_sensitive_to_species_cell_and_info(rng):
+    a = make_atoms(rng)
+    b = a.copy()
+    b.numbers = b.numbers.copy()
+    b.numbers[0] += 1
+    assert structure_key(a) != structure_key(b)
+    c = a.copy()
+    c.cell = c.cell * 1.01
+    assert structure_key(a) != structure_key(c)
+    d = a.copy()
+    d.info["charge"] = 1   # UMA conditioning changes the energy
+    assert structure_key(a) != structure_key(d)
+
+
+def test_cache_key_property_sets_never_alias(rng):
+    a = make_atoms(rng)
+    k_energy = cache_key(a, "m", properties=("energy",))
+    k_forces = cache_key(a, "m", properties=("energy", "forces"))
+    k_full = cache_key(a, "m", properties=None)
+    assert len({k_energy, k_forces, k_full}) == 3
+    # canonicalization: order/duplicates don't matter, 'energy' implied
+    assert cache_key(a, "m", properties=("forces", "energy")) == k_forces
+    assert cache_key(a, "m", properties=("forces",)) == k_forces
+    # model id and precision fold in
+    assert cache_key(a, "m2") != k_full
+    assert cache_key(a, "m", precision="bfloat16") != k_full
+
+
+def test_energy_only_entry_does_not_serve_forces_request(rng):
+    cache = ResultCache()
+    a = make_atoms(rng)
+    cache.put(cache_key(a, "m", properties=("energy",)),
+              {"energy": -1.0})
+    assert cache.get(cache_key(a, "m", properties=("energy", "forces"))) \
+        is None
+    assert cache.get(cache_key(a, "m", properties=("energy",))) \
+        == {"energy": -1.0}
+
+
+# ---------------------------------------------------------------------------
+# LRU / bytes / copy-on-return
+# ---------------------------------------------------------------------------
+
+
+def _result(nbytes_arr: int) -> dict:
+    return {"energy": -1.0,
+            "forces": np.zeros(nbytes_arr // 8, dtype=np.float64)}
+
+
+def test_lru_eviction_at_byte_bound():
+    entry = _result(1024)
+    from distmlip_tpu.fleet.result_cache import _result_bytes
+
+    per = _result_bytes(entry)
+    cache = ResultCache(max_bytes=3 * per)
+    for k in ("a", "b", "c"):
+        assert cache.put(k, _result(1024))
+    assert cache.get("a") is not None       # touch: "a" becomes MRU
+    assert cache.put("d", _result(1024))    # evicts LRU = "b"
+    assert cache.get("b") is None
+    assert cache.get("a") is not None
+    assert cache.get("c") is not None and cache.get("d") is not None
+    assert cache.total_bytes <= cache.max_bytes
+    assert cache.evictions == 1
+
+
+def test_oversized_entry_is_not_cached():
+    cache = ResultCache(max_bytes=256)
+    assert not cache.put("big", _result(4096))
+    assert cache.get("big") is None
+    assert cache.skipped_oversize == 1
+    assert cache.total_bytes == 0
+
+
+def test_copy_on_return_mutation_safety():
+    cache = ResultCache()
+    original = _result(256)
+    cache.put("k", original)
+    # mutating the PUT source must not reach the cache
+    original["forces"][:] = 7.0
+    got1 = cache.get("k")
+    assert np.all(got1["forces"] == 0.0)
+    # mutating a GET result must not reach the cache or other callers
+    got1["forces"][:] = 9.0
+    got2 = cache.get("k")
+    assert np.all(got2["forces"] == 0.0)
+    assert got1["forces"] is not got2["forces"]
+
+
+def test_hit_miss_counters(rng):
+    cache = ResultCache()
+    a = make_atoms(rng)
+    key = cache_key(a, "m")
+    assert cache.get(key) is None
+    cache.put(key, {"energy": -2.0})
+    assert cache.get(key)["energy"] == -2.0
+    st = cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    assert st["hit_rate"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# AOT executable cache
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pair_setup():
+    model = PairPotential(PairConfig(cutoff=4.0))
+    return model, model.init()
+
+
+def crystal_batch(rng, n_structs=3):
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5],
+                     [0, 0.5, 0.5]])
+    frac, lat = geometry.make_supercell(unit, np.eye(3) * 3.6, (2, 2, 2))
+    out = []
+    for _ in range(n_structs):
+        cart = geometry.frac_to_cart(frac, lat) + rng.normal(
+            0, 0.05, (len(frac), 3))
+        out.append(Atoms(numbers=np.full(len(cart), 14), positions=cart,
+                         cell=lat))
+    return out
+
+
+def test_aot_rehydrate_zero_recompiles_fp_identical(rng, pair_setup,
+                                                    tmp_path):
+    model, params = pair_setup
+    structs = crystal_batch(rng)
+    pot1 = BatchedPotential(model, params, caps=BucketPolicy())
+    install_aot_cache(pot1, str(tmp_path))
+    ref = pot1.calculate(structs)
+    assert pot1.compile_count == 1          # cold compile, then exported
+    assert pot1.aot_cache.stats()["saved"] == 1
+
+    # "restarted replica": fresh potential, same model/params/ladder
+    pot2 = BatchedPotential(model, params, caps=BucketPolicy())
+    install_aot_cache(pot2, str(tmp_path))
+    got = pot2.calculate(structs)
+    assert pot2.compile_count == 0          # the cold-start gate
+    assert pot2.aot_cache.stats()["rehydrated"] == 1
+    assert pot2.last_stats["aot_rehydrated"] is True
+    for r, g in zip(ref, got):
+        assert r["energy"] == g["energy"]   # fp-identical, not just close
+        assert np.array_equal(r["forces"], g["forces"])
+        assert np.array_equal(r["stress"], g["stress"])
+
+
+def test_aot_stale_key_falls_back_to_jit(rng, pair_setup, tmp_path):
+    model, params = pair_setup
+    structs = crystal_batch(rng)
+    pot1 = BatchedPotential(model, params, caps=BucketPolicy())
+    install_aot_cache(pot1, str(tmp_path))
+    ref = pot1.calculate(structs)
+    # same dir, WRONG model fingerprint (a retrained/retuned model):
+    # must miss and JIT, transparently
+    pot2 = BatchedPotential(model, params, caps=BucketPolicy())
+    install_aot_cache(pot2, AotExecutableCache(
+        str(tmp_path), fingerprint="stale", ladder="stale"))
+    got = pot2.calculate(structs)
+    assert pot2.compile_count == 1
+    assert pot2.aot_cache.stats()["rehydrated"] == 0
+    assert pot2.last_stats["aot_rehydrated"] is False
+    assert ref[0]["energy"] == got[0]["energy"]
+
+
+def test_aot_corrupt_entry_falls_back_to_jit(rng, pair_setup, tmp_path):
+    model, params = pair_setup
+    structs = crystal_batch(rng)
+    pot1 = BatchedPotential(model, params, caps=BucketPolicy())
+    install_aot_cache(pot1, str(tmp_path))
+    ref = pot1.calculate(structs)
+    # corrupt every serialized entry on disk
+    for name in os.listdir(tmp_path):
+        if name.endswith(".jaxexp"):
+            with open(tmp_path / name, "wb") as f:
+                f.write(b"not a serialized executable")
+    pot2 = BatchedPotential(model, params, caps=BucketPolicy())
+    install_aot_cache(pot2, str(tmp_path))
+    got = pot2.calculate(structs)
+    assert pot2.compile_count == 1          # transparent JIT fallback
+    assert pot2.aot_cache.stats()["errors"] >= 1
+    assert ref[0]["energy"] == got[0]["energy"]
+
+
+def test_ladder_fingerprint_changes_aot_key(rng, pair_setup, tmp_path):
+    model, params = pair_setup
+    pot = BatchedPotential(model, params, caps=BucketPolicy())
+    c1 = AotExecutableCache.for_potential(str(tmp_path), pot)
+    pot_coarse = BatchedPotential(
+        model, params, caps=BucketPolicy(growth=2.0))
+    c2 = AotExecutableCache.for_potential(str(tmp_path), pot_coarse)
+    assert c1.ladder != c2.ladder
+    assert c1.entry_key("n128_e1536_B2") != c2.entry_key("n128_e1536_B2")
+    # same config -> same key (the restart contract)
+    c3 = AotExecutableCache.for_potential(
+        str(tmp_path), BatchedPotential(model, params, caps=BucketPolicy()))
+    assert c1.entry_key("n128_e1536_B2") == c3.entry_key("n128_e1536_B2")

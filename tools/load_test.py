@@ -27,8 +27,20 @@ limit; no gate when neither exists). Combined with ``--check``, an
 error-severity finding fails the gate and the summary carries
 ``est_peak_bytes`` for the estimator-drift trajectory.
 
+``--fleet N`` switches to FLEET mode: an open-loop burst through a
+``FleetRouter`` over N in-process ``ServeEngine`` replicas (weighted
+tenants, content-addressed result cache, failover). ``--chaos
+kill-replica`` kills replica r0 mid-burst; ``--check`` then gates the
+chaos contract (every submitted Future resolves, zero stray failures,
+p99 under ``--p99-bound-s``), the compile bound (BucketPolicy ladder x
+replicas), and the cache contract (duplicate-phase hit rate >=
+``--cache-hit-floor`` with ZERO replica dispatches). Exit 3 on
+regression — this is the ROADMAP's fleet acceptance gate.
+
 Smoke (verify flow): ``python tools/load_test.py --requests 12 --check``
-(~seconds on CPU with the default pair model).
+(~seconds on CPU with the default pair model) and
+``python tools/load_test.py --fleet 2 --chaos kill-replica --requests 48
+--check``.
 """
 
 import argparse
@@ -247,6 +259,165 @@ def run(args) -> int:
     return rc
 
 
+def run_fleet(args) -> int:
+    """Fleet mode: open-loop burst through a FleetRouter over N in-process
+    replicas, optional replica-kill chaos mid-burst, duplicate phase for
+    the result-cache gate.
+
+    Phases: (1) submit ``requests // 2`` UNIQUE structures as a burst
+    (two tenants, weighted 4:1); with ``--chaos kill-replica``, replica
+    r0 is killed after half the burst is in; (2) harvest — every Future
+    must resolve; (3) re-submit the same structures (duplicates) — these
+    must come back from the content-addressed cache without touching a
+    replica. ``--check`` gates: all futures resolved with zero stray
+    failures, p99 under ``--p99-bound-s`` (failover included), total
+    compile count within the BucketPolicy ladder bound x replicas, and
+    duplicate-phase cache hit rate >= ``--cache-hit-floor`` with ZERO new
+    replica dispatches. Exit 3 on any regression."""
+    import time
+
+    from distmlip_tpu.calculators import BatchedPotential
+    from distmlip_tpu.fleet import FleetRouter, ResultCache, TenantConfig
+    from distmlip_tpu.partition import BucketPolicy
+    from distmlip_tpu.serve import ServeEngine
+    from distmlip_tpu.telemetry import JsonlSink, Telemetry
+
+    rng = np.random.default_rng(args.seed)
+    model, params = build_model(args.model)
+    telemetry = None
+    if args.jsonl:
+        telemetry = Telemetry([JsonlSink(args.jsonl)])
+    policies = [BucketPolicy() for _ in range(args.fleet)]
+    engines = [
+        ServeEngine(
+            BatchedPotential(model, params, caps=policies[i], skin=args.skin),
+            max_batch=args.max_batch, max_wait_s=args.max_wait,
+            max_queue=args.max_queue, admission="reject",
+            telemetry=telemetry)
+        for i in range(args.fleet)]
+    router = FleetRouter(
+        engines,
+        result_cache=ResultCache(max_bytes=args.cache_bytes),
+        model_id=args.model,
+        tenants={"interactive": TenantConfig(weight=4.0),
+                 "screening": TenantConfig(weight=1.0)},
+        telemetry=telemetry)
+
+    # phase 1: unique burst (each submission its own perturbed structure)
+    base_pool = make_pool(rng, max(8, args.requests // 8))
+    n_uniq = max(args.requests // 2, 2)
+    n_dup = max(args.requests - n_uniq, 1)
+    uniques = []
+    for i in range(n_uniq):
+        a = base_pool[i % len(base_pool)].copy()
+        a.positions = a.positions + rng.normal(0, 0.02, a.positions.shape)
+        uniques.append(a)
+    futs, t_sub = [], []
+    killed = reclaimed = 0
+    t0 = time.perf_counter()
+    for i, a in enumerate(uniques):
+        if args.chaos == "kill-replica" and i == n_uniq // 2 and not killed:
+            reclaimed = router.kill_replica("r0")
+            killed = 1
+        tenant = "interactive" if i % 4 == 0 else "screening"
+        t_sub.append(time.perf_counter())
+        futs.append(router.submit(a, tenant=tenant))
+    ok = failed = 0
+    lats = []
+    for f, ts in zip(futs, t_sub):
+        try:
+            f.result(timeout=300)
+        except Exception:  # noqa: BLE001 - explicit per-request error
+            failed += 1
+            continue
+        ok += 1
+        lats.append(time.perf_counter() - ts)
+    router.drain(timeout=120)
+    dispatched_before_dup = sum(
+        r["dispatched_total"]
+        for r in router.snapshot()["replicas"].values())
+    hits_before_dup = router.cache.hits
+
+    # phase 3: duplicate traffic — must be served by the cache alone
+    dup_futs = []
+    dup_ok = 0
+    for i in range(n_dup):
+        dup_futs.append(router.submit(uniques[i % n_uniq]))
+    for f in dup_futs:
+        try:
+            f.result(timeout=300)
+            dup_ok += 1
+        except Exception:  # noqa: BLE001
+            failed += 1
+    wall_s = time.perf_counter() - t0
+    snap = router.snapshot()
+    dispatched_after_dup = sum(
+        r["dispatched_total"] for r in snap["replicas"].values())
+    dup_hits = router.cache.hits - hits_before_dup
+    hit_rate = dup_hits / max(n_dup, 1)
+    compile_total = sum(r["compile_count"]
+                        for r in snap["replicas"].values())
+    lats.sort()
+    p99 = lats[min(len(lats) - 1, int(0.99 * (len(lats) - 1) + 0.5))] \
+        if lats else 0.0
+    router.close()
+    if telemetry is not None:
+        telemetry.close()
+
+    n_atoms = [len(a) for a in uniques]
+    bound = args.fleet * policies[0].ladder_bound(
+        min(n_atoms), sum(sorted(n_atoms)[-args.max_batch:]),
+        args.max_batch)
+    summary = {
+        "metric": "fleet_load_test",
+        "fleet": args.fleet,
+        "chaos": args.chaos,
+        "requests": n_uniq + n_dup,
+        "unique": n_uniq,
+        "duplicates": n_dup,
+        "ok": ok + dup_ok,
+        "failed": failed,
+        "reclaimed_on_kill": reclaimed,
+        "wall_s": round(wall_s, 3),
+        "latency_p99_ms": round(1e3 * p99, 2),
+        "compile_count": compile_total,
+        "compile_bound": bound,
+        "cache_hit_rate": round(hit_rate, 3),
+        "dup_dispatches": dispatched_after_dup - dispatched_before_dup,
+        "stats": snap["stats"],
+        "tenants": snap["tenants"],
+        "replicas": snap["replicas"],
+        "cache": snap["cache"],
+    }
+    if args.jsonl:
+        summary["jsonl"] = args.jsonl
+    rc = 0
+    if args.check:
+        checks = {
+            # the chaos contract: every submitted Future resolved, with a
+            # result — a killed replica may cost latency, never requests
+            "all_resolved": all(f.done() for f in futs + dup_futs),
+            "zero_lost": ok + dup_ok == n_uniq + n_dup and failed == 0,
+            "p99_bounded": p99 <= args.p99_bound_s,
+            "compile_bound": compile_total <= bound,
+            # the cache contract: duplicate traffic is served from the
+            # content-addressed cache without ANY replica dispatch
+            "cache_hit_floor": hit_rate >= args.cache_hit_floor,
+            "no_dispatch_on_hits":
+                dispatched_after_dup == dispatched_before_dup,
+        }
+        if args.chaos == "kill-replica":
+            checks["failover_observed"] = snap["stats"]["failovers"] >= 1
+        summary["checks"] = checks
+        if not all(checks.values()):
+            summary["check"] = "FAIL"
+            rc = 3
+        else:
+            summary["check"] = "ok"
+    print(json.dumps(summary), flush=True)
+    return rc
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=200)
@@ -276,12 +447,31 @@ def main(argv=None) -> int:
                         "with --check, any error-severity finding fails "
                         "the gate")
     p.add_argument("--occupancy-floor", type=float, default=0.95)
+    p.add_argument("--fleet", type=int, default=0,
+                   help="run FLEET mode instead: N in-process ServeEngine "
+                        "replicas behind a FleetRouter (tenant fairness, "
+                        "result cache, failover)")
+    p.add_argument("--chaos", choices=("none", "kill-replica"),
+                   default="none",
+                   help="fleet mode: kill replica r0 mid-burst; --check "
+                        "then also requires a failover and still zero "
+                        "lost requests")
+    p.add_argument("--cache-bytes", type=int, default=64 * 2**20,
+                   help="fleet mode: result-cache byte bound")
+    p.add_argument("--p99-bound-s", type=float, default=60.0,
+                   help="fleet mode --check: p99 latency bound (seconds), "
+                        "failover included")
+    p.add_argument("--cache-hit-floor", type=float, default=0.9,
+                   help="fleet mode --check: duplicate-phase result-cache "
+                        "hit-rate floor")
     p.add_argument("--hbm-budget-gb", type=float, default=None,
                    help="per-device HBM budget for the batched lane "
                         "(memory-aware autobatching + the --contracts "
                         "memory_budget gate); default: backend-reported "
                         "bytes_limit (none on CPU)")
     args = p.parse_args(argv)
+    if args.fleet > 0:
+        return run_fleet(args)
     return run(args)
 
 

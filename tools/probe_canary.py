@@ -8,7 +8,7 @@ tools/tpu_probe_forever.sh as the probe body — a single file owns the
 Exit 0: grant healthy, marker written. Exit 1: claim raised (fast-fail,
 e.g. UNAVAILABLE). A HANG means the grant is wedged — callers must poll
 with a budget and then KILL this process group on expiry (TERM -> grace
--> KILL; bench.py _kill_canary_group). Policy history: rounds 3/4 showed
+-> KILL; distmlip_tpu.utils.health.kill_process_group). Policy history: rounds 3/4 showed
 the PARENT dying mid-claim renews the server-side lease wedge, so the
 original contract left a hung canary running; BENCH_r05 then showed the
 leaked pid (`canary: left_running`) holding its pending claim long after

@@ -1,0 +1,180 @@
+"""Zero-recompile hot-swap: install fine-tuned weights into live serving.
+
+A fine-tuned parameter pytree with the SAME tree structure, leaf shapes
+and dtypes as the live one is a pure runtime input to every compiled
+executable — the bucket ladder, the jit cache, and any AOT-rehydrated
+executables all keep serving unchanged. So a swap is: take the
+potential's lock (no batch is mid-dispatch), assign the pytree, release.
+``compile_count`` is snapshotted around the swap and asserted unchanged;
+queued requests keep their heap order and in-flight Futures resolve
+normally — a request dispatched before the swap returns old-weight
+results, one dispatched after returns new-weight results, and nothing is
+ever dropped or reordered.
+
+Cache-key roll-forward (the stale-entry contract): a
+:class:`~distmlip_tpu.fleet.router.FleetRouter` keys its
+content-addressed result cache by ``model_id``. The swap first installs
+the new weights on EVERY replica, then rolls ``router.model_id`` to a
+new identity (caller-supplied, or the old id stamped with a digest of
+the new parameter VALUES). Ordering matters: after the roll, every new
+submission keys under the new id — and since every replica already
+serves the new weights, no old-weight result can ever be computed under
+(or served from) the new id. Results computed with the old weights stay
+keyed under the old id, which no future submission can reach. The AOT
+cache's model fingerprint is re-derived from the new params the same way
+(:func:`~distmlip_tpu.fleet.aot.model_fingerprint`) — unchanged for a
+pure value swap, because exported executables take params as runtime
+arguments and are weight-agnostic by construction; the roll keeps the
+invariant that the cache key always describes the live model, so a swap
+that DID alter the program shape could never rehydrate a stale
+executable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+class HotSwapError(RuntimeError):
+    """The candidate params cannot be installed as a pure pytree swap
+    (tree structure / leaf shape / dtype mismatch — installing them
+    would retrace and recompile, or silently misread buffers)."""
+
+
+def params_digest(params) -> str:
+    """Short content digest of the parameter VALUES — the model-identity
+    suffix the result-cache key rolls forward on a swap."""
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(params):
+        arr = np.asarray(leaf)
+        h.update(arr.shape.__repr__().encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:12]
+
+
+def check_swappable(live_params, new_params) -> None:
+    """Raise :class:`HotSwapError` unless ``new_params`` is a pure
+    drop-in for ``live_params`` (same treedef, leaf shapes, dtypes)."""
+    import jax
+
+    live_leaves, live_def = jax.tree.flatten(live_params)
+    new_leaves, new_def = jax.tree.flatten(new_params)
+    if live_def != new_def:
+        raise HotSwapError(
+            f"param tree structure changed: {new_def} vs live {live_def}")
+    for i, (a, b) in enumerate(zip(live_leaves, new_leaves)):
+        sa, sb = np.shape(a), np.shape(b)
+        da = np.asarray(a).dtype
+        db = np.asarray(b).dtype
+        if sa != sb or da != db:
+            raise HotSwapError(
+                f"param leaf {i} changed: {sb}/{db} vs live {sa}/{da} — "
+                f"a hot-swap must not alter the traced program")
+
+
+def swap_potential_params(pot, new_params) -> None:
+    """Install ``new_params`` on one potential as a pure pytree swap,
+    serialized against any in-flight ``calculate`` on the potential's
+    own lock. Works for ``BatchedPotential``, ``DistPotential`` and
+    ``EnsembleBatchedPotential`` (whose stacked member-0 slice follows
+    the primary)."""
+    set_primary = getattr(pot, "set_primary", None)
+    if set_primary is not None:
+        set_primary(new_params)
+        return
+    check_swappable(pot.params, new_params)
+    lock = getattr(pot, "_lock", None)
+    if lock is not None:
+        with lock:
+            pot.params = new_params
+    else:
+        pot.params = new_params
+
+
+def hot_swap_engine(engine, new_params) -> dict:
+    """Swap one ``ServeEngine``'s serving weights in place.
+
+    Swaps the shared batched potential, the engine-owned spatial lane
+    and any explicit fallback whose params are drop-in compatible (an
+    incompatible user-owned fallback is left alone and reported).
+    Returns a report dict; raises :class:`HotSwapError` (nothing
+    swapped) when the primary potential rejects the tree."""
+    pot = engine.potential
+    compile_before = engine.compile_count
+    check_swappable(pot.params, new_params)  # validate BEFORE any mutation
+    swap_potential_params(pot, new_params)
+    swapped_lanes = ["potential"]
+    skipped_lanes = []
+    for name in ("_spatial_lane", "fallback"):
+        lane = getattr(engine, name, None)
+        if lane is None:
+            continue
+        try:
+            swap_potential_params(lane, new_params)
+            swapped_lanes.append(name.lstrip("_"))
+        except HotSwapError:
+            # a user-owned fallback may legitimately run a different
+            # model; leave it serving its own weights
+            skipped_lanes.append(name.lstrip("_"))
+    aot = getattr(pot, "aot_cache", None)
+    if aot is not None:
+        from ..fleet.aot import model_fingerprint
+
+        aot.fingerprint = model_fingerprint(pot.model, new_params)
+    compile_after = engine.compile_count
+    if compile_after != compile_before:
+        raise HotSwapError(
+            f"hot swap changed compile_count {compile_before} -> "
+            f"{compile_after}; the swap must reuse every executable")
+    return {"compile_count": compile_after,
+            "swapped_lanes": swapped_lanes,
+            "skipped_lanes": skipped_lanes}
+
+
+def hot_swap_router(router, new_params, *, model_id: str | None = None
+                    ) -> dict:
+    """Swap every ALIVE replica's weights, then roll the cache identity.
+
+    Replicas first, identity last: once ``model_id`` changes, every new
+    submission keys (and coalesces) under the new identity against
+    replicas that all already serve the new weights — a stale old-weight
+    result can never be computed or served under the new id, and entries
+    under the old id become unreachable. Dead replicas are skipped (a
+    failed-over engine serves nothing; killing its stale weights is
+    moot). Returns a report with the new ``model_id`` and per-replica
+    swap reports."""
+    base_id = router.model_id.split("#", 1)[0]
+    new_id = (str(model_id) if model_id is not None
+              else f"{base_id}#{params_digest(new_params)}")
+    # validate EVERY alive replica before mutating ANY: a mixed fleet
+    # (some replicas on new weights, some refusing) under one model_id
+    # is exactly the cache-aliasing state this module exists to prevent.
+    # After this loop the per-replica swap can only fail on its
+    # compile-count assertion, which a pure assignment cannot trip.
+    for rid, rep in router.replicas.items():
+        if rep.alive:
+            check_swappable(rep.engine.potential.params, new_params)
+    replicas = {}
+    for rid, rep in router.replicas.items():
+        if not rep.alive:
+            replicas[rid] = {"skipped": "dead"}
+            continue
+        replicas[rid] = hot_swap_engine(rep.engine, new_params)
+    old_id, router.model_id = router.model_id, new_id
+    return {"model_id": new_id, "previous_model_id": old_id,
+            "replicas": replicas}
+
+
+def hot_swap(target, new_params, **kwargs) -> dict:
+    """Dispatch on the serving surface: a FleetRouter (swap + cache-key
+    roll), a ServeEngine (swap all lanes), or a bare potential."""
+    if hasattr(target, "replicas") and hasattr(target, "model_id"):
+        return hot_swap_router(target, new_params, **kwargs)
+    if hasattr(target, "potential") and hasattr(target, "compile_count"):
+        return hot_swap_engine(target, new_params, **kwargs)
+    swap_potential_params(target, new_params)
+    return {"swapped_lanes": ["potential"]}

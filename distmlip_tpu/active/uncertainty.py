@@ -1,0 +1,224 @@
+"""Uncertainty on the serving path: the batched ensemble evaluator.
+
+``EnsembleBatchedPotential`` is a :class:`~distmlip_tpu.calculators.
+batched.BatchedPotential` whose ``calculate`` serves the PRIMARY member's
+weights exactly as before (the cheap path every request rides), plus a
+``calculate_with_variance`` that re-evaluates the same packed batch under
+ALL members in ONE device launch — ``jax.vmap`` over the stacked member
+parameter pytrees riding the existing packed program, the same one-launch
+trick ``EnsemblePotential.stacked`` plays for ``DistPotential``
+(calculators/calculator.py). Because both paths share the potential's
+pack/skin cache (``_prepare_batch``), escalating a just-served batch
+costs one vmapped dispatch — no repack, no second graph upload, and
+ZERO additional collectives vs the single-member program (pinned by
+``tools/contract_check.py``'s ``ensemble[...]`` program).
+
+The cheap-first escalation policy lives in :class:`EscalationPolicy`:
+serve the single model always; re-evaluate under the ensemble only when
+a sampling policy fires or the caller opts in (``ActiveLoop.submit(...,
+escalate=True)``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..calculators.atoms import EV_A3_TO_GPA
+from ..calculators.batched import BatchedPotential
+from ..telemetry import annotate
+
+
+@dataclass
+class EscalationPolicy:
+    """When a served request is re-evaluated under the ensemble, and when
+    a re-evaluated structure is admitted to the replay buffer.
+
+    ``sample_rate`` is the fraction of served requests escalated by the
+    sampling policy (callers can always force/suppress escalation per
+    request). ``energy_var_floor`` / ``force_var_floor`` gate buffer
+    admission: a structure lands in the buffer when its ensemble energy
+    variance (eV², per structure) or max per-component force variance
+    ((eV/Å)²) reaches its floor — both 0 admits every escalated
+    structure. ``max_pending`` bounds the escalation queue (oldest
+    dropped first; the loop counts drops)."""
+
+    sample_rate: float = 0.0
+    energy_var_floor: float = 0.0
+    force_var_floor: float = 0.0
+    max_pending: int = 1024
+
+    def admits(self, energy_var: float, force_var_max: float) -> bool:
+        if self.energy_var_floor <= 0.0 and self.force_var_floor <= 0.0:
+            return True
+        return (0.0 < self.energy_var_floor <= energy_var
+                or 0.0 < self.force_var_floor <= force_var_max)
+
+
+def variance_score(result: dict) -> float:
+    """The scalar priority the buffer/trigger machinery ranks by: the max
+    per-component force variance (forces are what MD/relax consume, and
+    the force field is where MLIP uncertainty actually bites), falling
+    back to the energy variance for empty structures."""
+    fv = np.asarray(result.get("forces_var", 0.0))
+    if fv.size:
+        return float(fv.max())
+    return float(result.get("energy_var", 0.0))
+
+
+class EnsembleBatchedPotential(BatchedPotential):
+    """Batched potential with an M-member uncertainty lane.
+
+    ``params_list[0]`` is the PRIMARY (serving) member: ``calculate``
+    behaves exactly like a ``BatchedPotential`` over those weights, so a
+    ``ServeEngine`` can use this object as its shared potential with no
+    behavior change. ``calculate_with_variance`` evaluates every member
+    over the same packed graph via one vmapped dispatch and returns
+    per-structure mean/variance plus the per-member stacks.
+
+    ``set_primary`` is the hot-swap hook: a pure pytree swap of the
+    serving weights (and the member-0 slice of the stacked params) that
+    by construction reuses every compiled executable — the swap refuses
+    any tree whose structure/shapes/dtypes differ from the live one.
+    """
+
+    def __init__(self, model, params_list, **kwargs):
+        params_list = list(params_list)
+        if not params_list:
+            raise ValueError("params_list must be non-empty")
+        super().__init__(model, params_list[0], **kwargs)
+        self.member_count = len(params_list)
+        self._stack_members(params_list)
+        self._vpot = None
+
+    # ---- member management ----
+
+    def _stack_members(self, params_list) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        treedefs = {str(jax.tree.structure(p)) for p in params_list}
+        if len(treedefs) != 1:
+            raise ValueError("ensemble members must share one param "
+                             "pytree structure")
+        self.stacked_params = jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *params_list)
+
+    def member_params(self, k: int):
+        """Member ``k``'s parameter pytree (unstacked view)."""
+        import jax
+
+        if not 0 <= k < self.member_count:
+            raise IndexError(f"member {k} outside [0, {self.member_count})")
+        return jax.tree.map(lambda s: s[k], self.stacked_params)
+
+    def set_primary(self, new_params) -> None:
+        """Install new PRIMARY weights (member 0) as a pure pytree swap.
+
+        Thread-safe against a concurrent ``calculate`` (takes the same
+        lock the scheduler thread serializes on) and recompile-free by
+        construction: the tree structure, leaf shapes and dtypes must
+        match the live params exactly, so every jitted executable —
+        including AOT-rehydrated ones — keeps serving unchanged."""
+        import jax
+        import jax.numpy as jnp
+
+        from .hotswap import check_swappable
+
+        check_swappable(self.params, new_params)
+        with self._lock:
+            self.params = new_params
+            self.stacked_params = jax.tree.map(
+                lambda s, p: s.at[0].set(jnp.asarray(p, s.dtype)),
+                self.stacked_params, new_params)
+
+    # ---- the vmapped uncertainty lane ----
+
+    def _ensure_vpot(self):
+        if self._vpot is None:
+            import jax
+
+            # vmap the underlying jit, not the AOT dispatcher wrapper
+            # (exported executables don't batch; the jit retraces once
+            # for the member-stacked shapes and caches like any bucket)
+            fn = getattr(self._potential, "_jit", self._potential)
+            self._vpot = jax.vmap(fn, in_axes=(0, None, None))
+        return self._vpot
+
+    def calculate_with_variance(self, structures) -> list:
+        """Evaluate the batch under EVERY member in one vmapped launch.
+
+        Returns one dict per input structure: ensemble-mean ``energy`` /
+        ``forces`` / ``stress`` (same keys ``calculate`` produces), plus
+        ``energy_var``, ``forces_var`` (per-atom, per-component),
+        ``energies`` (M,), ``forces_all`` (M, n, 3) and
+        ``committee_energy``/``committee_forces`` — the mean over the
+        NON-primary members, the label an active-learning buffer wants
+        when the primary itself is the model being corrected (falls back
+        to the full mean for M == 1)."""
+        structures = list(structures)
+        if not structures:
+            return []
+        with self._lock:
+            return self._variance_locked(structures)
+
+    def _variance_locked(self, structures) -> list:
+        graph, host, positions, reused, refreshed, rebuild_s, \
+            (t0, t1, t2) = self._prepare_batch(structures)
+        vpot = self._ensure_vpot()
+        with annotate("distmlip/ensemble_batched"):
+            out = vpot(self.stacked_params, graph, positions)
+        M = self.member_count
+        slots = host.structure_slots
+        energies = np.asarray(out["energies"], dtype=np.float64)[:, slots]
+        strain_grad = np.asarray(out["strain_grad"])[:, slots]
+        forces_by_member = [
+            host.gather_per_structure(np.asarray(out["forces"])[k])
+            for k in range(M)]
+        results = []
+        for b in range(len(structures)):
+            f_all = np.stack([forces_by_member[k][b] for k in range(M)])
+            e_all = energies[:, b]
+            vol = max(host.volumes[b], 1e-30)
+            s_all = strain_grad[:, b] / vol
+            stress = s_all.mean(axis=0)
+            res = {
+                "energy": float(e_all.mean()),
+                "free_energy": float(e_all.mean()),
+                "forces": f_all.mean(axis=0),
+                "stress": stress,
+                "stress_GPa": stress * EV_A3_TO_GPA,
+                "energy_var": float(e_all.var()),
+                "forces_var": f_all.var(axis=0),
+                "energies": e_all,
+                "forces_all": f_all,
+            }
+            if M > 1:
+                res["committee_energy"] = float(e_all[1:].mean())
+                res["committee_forces"] = f_all[1:].mean(axis=0)
+            else:
+                res["committee_energy"] = res["energy"]
+                res["committee_forces"] = res["forces"]
+            results.append(res)
+        t3 = time.perf_counter()
+        self.last_timings = {
+            "neighbor_s": (t1 - t0) - rebuild_s, "partition_s": t2 - t1,
+            "device_s": t3 - t2, "total_s": t3 - t0,
+        }
+        if refreshed:
+            self.last_timings["rebuild_s"] = rebuild_s
+        self.last_stats = dict(host.stats or {})
+        self.last_stats.update(
+            batch_size=len(structures), member_count=M,
+            rebuild_count=int(not reused),
+            rebuild_on_device=int(refreshed),
+            rebuild_overflow_count=self.rebuild_overflow_count)
+        from ..utils.memory import device_memory_stats
+
+        self._emit_record(host, len(structures), reused, refreshed,
+                          t3 - t0, device_memory_stats(),
+                          kind="ensemble_batched", member_count=M)
+        return results

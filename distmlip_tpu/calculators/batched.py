@@ -381,7 +381,15 @@ class BatchedPotential:
         with self._lock:
             return self._calculate_locked(structures)
 
-    def _calculate_locked(self, structures) -> list:
+    def _prepare_batch(self, structures):
+        """Build or reuse the packed graph and upload the batch positions —
+        the shared front half of every batched evaluation (the single-model
+        ``calculate`` and the ensemble evaluator's vmapped pass ride the
+        SAME cache/refresh machinery, so an escalation re-evaluation of a
+        just-served batch is a cache hit, not a repack). Called under the
+        lock; returns ``(graph, host, positions, reused, refreshed,
+        rebuild_s, (t0, t1, t2))`` with the phase timestamps the caller
+        folds into ``last_timings``."""
         t0 = time.perf_counter()
         reused = self._cache_valid(structures)
         refreshed = False
@@ -411,6 +419,12 @@ class BatchedPotential:
             with annotate("distmlip/positions_upload"):
                 positions = self._put_positions(host, structures, dtype)
         t2 = time.perf_counter()
+        return graph, host, positions, reused, refreshed, rebuild_s, \
+            (t0, t1, t2)
+
+    def _calculate_locked(self, structures) -> list:
+        graph, host, positions, reused, refreshed, rebuild_s, \
+            (t0, t1, t2) = self._prepare_batch(structures)
         with annotate("distmlip/batched_potential"):
             from ..kernels.dispatch import counting
 
@@ -494,7 +508,9 @@ class BatchedPotential:
 
     def _emit_record(self, host, n_structures: int, reused: bool,
                      refreshed: bool, total_s: float,
-                     mem_stats: dict | None = None) -> None:
+                     mem_stats: dict | None = None,
+                     kind: str = "batched_calculate",
+                     member_count: int = 0) -> None:
         self._step_counter += 1
         tel = self.telemetry
         if tel is None or not tel.wants_records():
@@ -503,7 +519,7 @@ class BatchedPotential:
         compiled = cache_size > self._last_compile_count
         self._last_compile_count = cache_size
         rec = StepRecord(
-            step=self._step_counter, kind="batched_calculate",
+            step=self._step_counter, kind=kind, member_count=member_count,
             timings=dict(self.last_timings),
             compile_cache_size=cache_size, compiled=compiled,
             graph_reused=reused, rebuild=not reused,

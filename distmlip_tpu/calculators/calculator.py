@@ -1039,6 +1039,13 @@ class EnsemblePotential:
     MXU). ``stacked=False`` falls back to sequential members sharing a
     capacity policy. Results carry ensemble mean, variance, and the
     per-member stack.
+
+    Telemetry parity with ``DistPotential``/``BatchedPotential``: every
+    ``calculate`` fills ``last_stats`` (graph/occupancy stats plus
+    ``member_count``) and, with a telemetry hub attached, emits ONE
+    ``ensemble_calculate`` StepRecord for the whole ensemble step (the
+    sequential fallback's members additionally emit their own per-member
+    ``calculate`` records, as any DistPotential does).
     """
 
     def __init__(self, model, params_list, stacked: bool | None = None, **kwargs):
@@ -1049,6 +1056,9 @@ class EnsemblePotential:
         if stacked is None:
             stacked = True
         self.stacked = bool(stacked)
+        self.member_count = len(params_list)
+        self.last_stats: dict = {}
+        self.last_timings: dict = {}
         self.compute_stress = base.compute_stress
         if self.stacked:
             import jax
@@ -1067,7 +1077,20 @@ class EnsemblePotential:
                 DistPotential(model, p, **kwargs) for p in params_list[1:]
             ]
 
+    @property
+    def telemetry(self):
+        return self.members[0].telemetry
+
+    def attach_telemetry(self, telemetry) -> None:
+        """Same precedence policy as the potentials: the first attached
+        hub wins; every member shares it (sequential members' per-member
+        records land in the same sinks as the ensemble record)."""
+        for m in self.members:
+            m.attach_telemetry(telemetry)
+
     def calculate(self, atoms: Atoms) -> dict:
+        t_start = time.perf_counter()
+        host = None
         if self.stacked:
             base = self.members[0]
             graph, host, positions = base._prepare(atoms)
@@ -1124,4 +1147,19 @@ class EnsemblePotential:
         if magmoms is not None:
             result["magmoms"] = magmoms.mean(axis=0)
             result["magmoms_all"] = magmoms
+        # telemetry parity: the ensemble step reports the same last_stats
+        # surface the single potentials do (uniform serving telemetry
+        # whichever lane served the request), plus member_count, and
+        # emits ONE ensemble_calculate record for the whole step
+        base = self.members[0]
+        if host is not None:                    # stacked: stats live on host
+            stats = dict(getattr(host, "stats", None) or {})
+        else:                                   # sequential: base.calculate
+            stats = dict(base.last_stats or {})     # already snapshotted
+        stats["member_count"] = self.member_count
+        self.last_stats = stats
+        self.last_timings = dict(base.last_timings)
+        base._emit_record("ensemble_calculate", host,
+                          total_s=time.perf_counter() - t_start,
+                          member_count=self.member_count)
         return result

@@ -97,6 +97,7 @@ class ServeStats:
     cancelled: int = 0
     rejected: int = 0
     deadline_misses: int = 0
+    shed_count: int = 0          # deadline-shed at assembly (never ran)
     batches: int = 0
     fallback_requests: int = 0
     scheduler_errors: int = 0    # isolated loop faults (engine survived)
@@ -165,6 +166,19 @@ class ServeEngine:
     max_batch_atoms : per-structure size ceiling for the batched lane;
         larger structures route to ``fallback``. None disables routing.
     window : how deep past the queue head assembly may scan.
+    shed_deadlines : deadline-aware LOAD SHEDDING (off by default — the
+        historical contract delivers late results and only counts the
+        miss). When on, a queued request whose deadline has already
+        passed at assembly time — or which PROVABLY cannot be met even
+        if dispatched in the very next batch, judged against the
+        engine's EWMA batch service time — fails fast with
+        ``ServeRejected`` instead of occupying batch slots, so a
+        backed-up queue sheds the work nobody will use and the live
+        deadlines keep making it. Shed requests count in
+        ``stats.shed_count`` (and the ``shed_count`` StepRecord field),
+        never in ``deadline_misses``. The service EWMA is measured in
+        real seconds; with an injected test clock, seed
+        ``_service_ewma`` directly.
     clock : monotonic-seconds callable; tests inject a fake clock so the
         max-wait timer is deterministic (no real sleeps).
     start : spawn the scheduler thread immediately. ``start=False`` lets
@@ -182,6 +196,7 @@ class ServeEngine:
         admission: str = "reject",
         max_batch_atoms: int | None = None,
         window: int = 64,
+        shed_deadlines: bool = False,
         telemetry=None,
         clock=None,
         start: bool = True,
@@ -202,6 +217,11 @@ class ServeEngine:
         self.max_batch_atoms = (int(max_batch_atoms)
                                 if max_batch_atoms is not None else None)
         self.window = int(window)
+        self.shed_deadlines = bool(shed_deadlines)
+        # EWMA of per-batch service seconds — the fastest a freshly
+        # queued request could possibly complete (None until the first
+        # dispatch lands; the predictive shed rule stays off until then)
+        self._service_ewma: float | None = None
         self._real_clock = clock is None
         self._clock = clock if clock is not None else time.monotonic
         self.telemetry = telemetry
@@ -472,11 +492,12 @@ class ServeEngine:
                 if not ready:
                     self._cv.wait(timeout=self._wait_timeout(now - oldest))
                     continue
-                batch, oversized, overbudget = self._assemble_locked()
+                batch, oversized, overbudget, shed = \
+                    self._assemble_locked(now)
                 self._inflight += 1
                 self._cv.notify_all()   # admission slots freed
             try:
-                self._run_dispatch(batch, oversized, overbudget, now)
+                self._run_dispatch(batch, oversized, overbudget, shed, now)
             except BaseException:  # noqa: BLE001 - the loop must survive
                 self.stats.scheduler_errors += 1
                 import traceback
@@ -490,18 +511,40 @@ class ServeEngine:
                     self._last_progress = self._clock()
                     self._cv.notify_all()
 
-    def _assemble_locked(self):
+    def _provably_late(self, req: _Request, now: float) -> bool:
+        """Deadline shedding predicate: the deadline already passed, or —
+        given the EWMA batch service time — the request would miss even
+        if dispatched in the very next batch (the most optimistic drain
+        the queue can offer)."""
+        if req.deadline_abs == float("inf"):
+            return False
+        if req.deadline_abs <= now:
+            return True
+        ewma = self._service_ewma
+        return ewma is not None and req.deadline_abs < now + ewma
+
+    def _note_service(self, service_s: float) -> None:
+        """Fold one dispatch's service time into the shedding EWMA."""
+        prev = self._service_ewma
+        self._service_ewma = (service_s if prev is None
+                              else 0.7 * prev + 0.3 * service_s)
+
+    def _assemble_locked(self, now: float):
         """Pop the next micro-batch (plus any oversized requests seen
-        while scanning, and a head whose solo HBM estimate is over
-        budget — failed by the dispatcher, never run). Called under the
-        lock; returns ``(batch, oversized, overbudget)``."""
+        while scanning, a head whose solo HBM estimate is over budget,
+        and — with ``shed_deadlines`` — requests whose deadline provably
+        cannot be met; all failed by the dispatcher, never run). Called
+        under the lock; returns ``(batch, oversized, overbudget,
+        shed)``."""
         window: list[_Request] = []
         limit = max(self.window, self.max_batch)
         while self._pending and len(window) < limit:
             window.append(heapq.heappop(self._pending))
-        oversized, normal = [], []
+        oversized, normal, shed = [], [], []
         for r in window:
-            if (self.max_batch_atoms is not None
+            if self.shed_deadlines and self._provably_late(r, now):
+                shed.append(r)
+            elif (self.max_batch_atoms is not None
                     and r.n_atoms > self.max_batch_atoms):
                 oversized.append(r)
             else:
@@ -525,13 +568,27 @@ class ServeEngine:
                     # not picked this round (occupancy rule / slot budget):
                     # keep its queue position for the next batch
                     heapq.heappush(self._pending, r)
-        return batch, oversized, overbudget
+        return batch, oversized, overbudget, shed
 
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
 
-    def _run_dispatch(self, batch, oversized, overbudget, t_dispatch) -> None:
+    def _run_dispatch(self, batch, oversized, overbudget, shed,
+                      t_dispatch) -> None:
+        for req in shed:
+            # outside the lock (done-callbacks run here). Shed requests
+            # were healthy at admission and expired in the queue: they
+            # count in shed_count, not in failed/deadline_misses
+            for r in self._start_requests([req]):
+                self.stats.shed_count += 1
+                why = ("has already passed" if r.deadline_abs <= t_dispatch
+                       else "provably cannot be met at the current queue "
+                            "drain rate")
+                r.future.set_exception(ServeRejected(
+                    f"deadline shed: the request's deadline {why} (queue "
+                    f"wait {t_dispatch - r.t_submit:.3f}s); retry with a "
+                    f"looser deadline or more capacity"))
         for req in overbudget:
             # outside the lock: failing a Future runs its done-callbacks.
             # Accounting: this request WAS accepted (it predates the bytes
@@ -643,6 +700,9 @@ class ServeEngine:
             return
         t_done = self._clock()
         self.stats.fallback_requests += 1
+        # deliberately NOT folded into the shedding EWMA: one slow
+        # oversized request on the spatial lane would inflate the
+        # batched lane's drain estimate and shed healthy deadlines
         self._resolve(req, result, t_done)
         # unified stats emission: the spatial/fallback lane reports the
         # same last_stats surface the batched lane does, so fallback
@@ -697,6 +757,7 @@ class ServeEngine:
             for r, res in zip(good, results):
                 self._resolve(r, res, t_done)
         service = time.perf_counter() - t0
+        self._note_service(service)
         self.stats.batches += 1
         if results is not None:
             occupancy = (len(good) / pot_stats["batch_slots"]
@@ -737,6 +798,7 @@ class ServeEngine:
                                for r in requests],
             reject_count=self.stats.rejected,
             deadline_miss_count=self.stats.deadline_misses,
+            shed_count=self.stats.shed_count,
             structures_per_sec=(len(requests) / service_s
                                 if service_s > 0 else 0.0),
         )

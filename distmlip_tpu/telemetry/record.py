@@ -76,6 +76,12 @@ class StepRecord:
     request_latency_s: list[float] = field(default_factory=list)  # submit→done
     reject_count: int = 0            # cumulative admission rejects at emit
     deadline_miss_count: int = 0     # cumulative deadline misses at emit
+    shed_count: int = 0              # cumulative deadline-shed requests at emit
+
+    # --- ensemble / active-learning (calculators.EnsemblePotential,
+    #     active/uncertainty.py; kind ensemble_calculate/ensemble_batched
+    #     and the active_* records) ---
+    member_count: int = 0            # ensemble members evaluated (0: single)
 
     # --- serving fleet (fleet/router.py; kind fleet_request) ---
     tenant: str = ""                 # submitting tenant ("" = unattributed)

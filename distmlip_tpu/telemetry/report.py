@@ -160,6 +160,7 @@ class Report:
             out.append(
                 f"  rejects={s['rejects']} "
                 f"deadline_misses={s['deadline_misses']} "
+                f"sheds={s.get('sheds', 0)} "
                 f"fallback_batches={s['fallback_batches']}")
         if c.get("fleet"):
             fl = c["fleet"]
@@ -182,6 +183,24 @@ class Report:
                              for rid, frac in fl["replica_share"].items())
             if share:
                 out.append(f"  replica load share: {share}")
+        if c.get("active"):
+            a = c["active"]
+            out.append("")
+            out.append("active learning (ActiveLoop):")
+            out.append(
+                f"  submitted={a['submitted']} escalated={a['escalated']} "
+                f"rate={a['escalation_rate']:.2f} "
+                f"members={a['member_count']} "
+                f"buffer depth={a['buffer_depth']} "
+                f"added={a['buffer_added']}")
+            if "variance_p50" in a:
+                out.append(
+                    f"  variance p50={a['variance_p50']:.3g} "
+                    f"p90={a['variance_p90']:.3g} "
+                    f"max={a['variance_max']:.3g}")
+            out.append(
+                f"  finetunes={a['finetunes']} shipped={a['shipped']} "
+                f"hot_swaps={a['swaps']}")
         if c.get("training"):
             t = c["training"]
             out.append("")
@@ -434,6 +453,7 @@ def aggregate(
             # cumulative counters: the LAST record carries the run totals
             "rejects": max(r.reject_count for r in serve),
             "deadline_misses": max(r.deadline_miss_count for r in serve),
+            "sheds": max(r.shed_count for r in serve),
         }
 
     # --- serving fleet: per-tenant tails, per-replica load, cache ---
@@ -522,6 +542,41 @@ def aggregate(
                 f"{c['fleet']['cache_hit_rate']:.1%} hit rate over "
                 f"{len(fleet)} request(s) — the result cache's byte bound "
                 f"is far below the working set"))
+
+    # --- active learning: escalation variance, buffer depth, swaps ---
+    act = [r for r in records if r.kind.startswith("active_")]
+    if act:
+        esc = [r for r in act if r.kind == "active_escalate"]
+        fts = [r for r in act if r.kind == "active_finetune"]
+        swaps = [r for r in act if r.kind == "active_swap"]
+        variances = sorted(float(v) for r in esc
+                           for v in (r.extra or {}).get("variances", []))
+        submitted = max((int((r.extra or {}).get("submitted_total", 0))
+                         for r in esc), default=0)
+        escalated = max((int((r.extra or {}).get("escalated_total", 0))
+                         for r in esc), default=0)
+        depth = max((int((r.extra or {}).get("buffer_depth", 0))
+                     for r in act), default=0)
+        a = {
+            "evaluations": len(variances),
+            "submitted": submitted,
+            "escalated": escalated,
+            "escalation_rate": (escalated / submitted if submitted
+                                else 0.0),
+            "buffer_depth": depth,
+            "buffer_added": sum(int((r.extra or {}).get("buffer_added", 0))
+                                for r in esc),
+            "finetunes": len(fts),
+            "shipped": sum(bool((r.extra or {}).get("shipped"))
+                           for r in fts),
+            "swaps": len(swaps),
+            "member_count": max((r.member_count for r in act), default=0),
+        }
+        if variances:
+            a["variance_p50"] = percentile(variances, 0.50)
+            a["variance_p90"] = percentile(variances, 0.90)
+            a["variance_max"] = variances[-1]
+        c["active"] = a
 
     # --- training loop: loss trajectory + optimizer dynamics ---
     train = [r for r in records if r.kind == "train_step"]

@@ -260,6 +260,57 @@ def _trace_packed_batch(programs_out):
         config={"max_total_collectives": 0}))
 
 
+def _trace_ensemble(programs_out, want=_want_all):
+    """The vmapped ensemble programs (active/uncertainty.py and
+    EnsemblePotential.stacked): vmap over M stacked member param pytrees
+    riding the SAME potential program. The pin: batching members adds
+    ZERO collectives vs the single-member program — one launch, one set
+    of ppermutes — enforced by setting the ensemble program's
+    ``max_total_collectives`` to the single-member program's traced
+    count (and 0 outright for the single-partition packed-batch
+    evaluator, which is communication-free either way)."""
+    names = ("ensemble[tensornet][2x1][M=2]",
+             "ensemble_batched[tensornet][B=2][M=2]")
+    wanted = [n for n in names if want(n)]
+    if not wanted:
+        return
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    from distmlip_tpu.analysis import Program
+    from distmlip_tpu.parallel import (BATCH_AXIS, graph_mesh,
+                                       make_batched_potential_fn,
+                                       make_potential_fn)
+    from distmlip_tpu.parallel.audit import count_collectives
+
+    model, params, use_bg, bond_r = make_model("tensornet")
+    stacked = jax.tree.map(lambda p: jnp.stack([p, p]), params)
+    with enable_x64():
+        if names[0] in wanted:
+            graph = _graph_for(model, use_bg, bond_r, 2)
+            pfn = make_potential_fn(model.energy_fn, graph_mesh(2))
+            jx_single = jax.make_jaxpr(pfn)(params, graph, graph.positions)
+            n_single = sum(count_collectives(jx_single).values())
+            vfn = jax.vmap(pfn, in_axes=(0, None, None))
+            jx = jax.make_jaxpr(vfn)(stacked, graph, graph.positions)
+            programs_out.append(Program(
+                name=names[0], jaxpr=jx,
+                tags=frozenset({"grad", "mesh", "x64"}),
+                config={"forbidden_axes": [BATCH_AXIS],
+                        "axis_budget": {BATCH_AXIS: {"psum": 1}},
+                        "max_total_collectives": n_single}))
+        if names[1] in wanted:
+            g = _packed_graph(model, use_bg, bond_r, batch=2)
+            bfn = make_batched_potential_fn(model.energy_fn)
+            vbfn = jax.vmap(bfn, in_axes=(0, None, None))
+            jx = jax.make_jaxpr(vbfn)(stacked, g, g.positions)
+            programs_out.append(Program(
+                name=names[1], jaxpr=jx,
+                tags=frozenset({"grad", "x64"}),
+                config={"max_total_collectives": 0}))
+
+
 def _trace_device_md(programs_out):
     """The DeviceMD chunk stepper with the in-loop neighbor rebuild:
     N steps = ONE device program, mandatory-zero host syncs."""
@@ -473,6 +524,7 @@ def main(argv=None) -> int:
                 _trace_model_programs(name, programs, want)
             if want("packed_batch[tensornet][B=4]"):
                 _trace_packed_batch(programs)
+            _trace_ensemble(programs, want)
             if want("device_md[pair][1x1]"):
                 _trace_device_md(programs)
             _trace_train_step(programs, want)

@@ -37,6 +37,17 @@ replicas), and the cache contract (duplicate-phase hit rate >=
 ``--cache-hit-floor`` with ZERO replica dispatches). Exit 3 on
 regression — this is the ROADMAP's fleet acceptance gate.
 
+``--active`` (fleet mode only) attaches an ``ActiveLoop``
+(distmlip_tpu.active): traffic routes through the loop, a sampled
+fraction escalates to the vmapped ensemble evaluator, high-variance
+structures land in the replay buffer, and after the cache phase a
+SECOND burst runs with a mid-burst zero-recompile HOT-SWAP of perturbed
+weights into every live replica. ``--check`` then additionally gates
+the swap contract: every swap-burst Future resolves with zero failures,
+per-replica compile counts are UNCHANGED across the swap burst (the
+pytree swap reuses every executable), the router's cache model-id
+rolled forward, and escalations were actually evaluated.
+
 Smoke (verify flow): ``python tools/load_test.py --requests 12 --check``
 (~seconds on CPU with the default pair model) and
 ``python tools/load_test.py --fleet 2 --chaos kill-replica --requests 48
@@ -303,6 +314,36 @@ def run_fleet(args) -> int:
                  "screening": TenantConfig(weight=1.0)},
         telemetry=telemetry)
 
+    # --active: attach the ActiveLoop — traffic routes through it, a
+    # sampled fraction escalates to the vmapped ensemble evaluator
+    loop = None
+    if args.active:
+        import jax
+
+        from distmlip_tpu.active import (ActiveLoop, EnsembleBatchedPotential,
+                                         EscalationPolicy, FineTuneTrigger,
+                                         ReplayBuffer, TriggerPolicy)
+
+        key = jax.random.PRNGKey(1)
+        member = jax.tree.map(
+            lambda x: x + 0.05 * jax.random.normal(
+                jax.random.fold_in(key, 1), np.shape(x),
+                np.asarray(x).dtype)
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+            params)
+        ensemble = EnsembleBatchedPotential(model, [params, member],
+                                            skin=args.skin)
+        loop = ActiveLoop(
+            router, ensemble, ReplayBuffer(capacity=256),
+            policy=EscalationPolicy(sample_rate=0.25),
+            # the smoke swaps explicitly mid-burst; keep the trigger out
+            trigger=FineTuneTrigger(TriggerPolicy(min_buffer=1 << 30)),
+            telemetry=telemetry, seed=args.seed)
+
+    def fleet_submit(atoms, **kw):
+        return loop.submit(atoms, **kw) if loop is not None \
+            else router.submit(atoms, **kw)
+
     # phase 1: unique burst (each submission its own perturbed structure)
     base_pool = make_pool(rng, max(8, args.requests // 8))
     n_uniq = max(args.requests // 2, 2)
@@ -321,7 +362,7 @@ def run_fleet(args) -> int:
             killed = 1
         tenant = "interactive" if i % 4 == 0 else "screening"
         t_sub.append(time.perf_counter())
-        futs.append(router.submit(a, tenant=tenant))
+        futs.append(fleet_submit(a, tenant=tenant))
     ok = failed = 0
     lats = []
     for f, ts in zip(futs, t_sub):
@@ -349,12 +390,86 @@ def run_fleet(args) -> int:
             dup_ok += 1
         except Exception:  # noqa: BLE001
             failed += 1
-    wall_s = time.perf_counter() - t0
-    snap = router.snapshot()
+    snap_dup = router.snapshot()
     dispatched_after_dup = sum(
-        r["dispatched_total"] for r in snap["replicas"].values())
+        r["dispatched_total"] for r in snap_dup["replicas"].values())
     dup_hits = router.cache.hits - hits_before_dup
     hit_rate = dup_hits / max(n_dup, 1)
+
+    # --active phase: a second burst over the (already warm) buckets
+    # with a mid-burst hot-swap of perturbed weights — the zero-lost /
+    # zero-recompile gate. Distinct property sets keep the pre-swap half
+    # off the result cache; the swap's model-id roll keys the post-swap
+    # half fresh.
+    # wall_s measures the load-test traffic (burst + cache phases) — the
+    # active phase's warm-up/swap bursts are timed separately below so
+    # --active runs stay comparable with plain fleet runs
+    wall_s = time.perf_counter() - t0
+    swap_futs = []
+    swap_ok = 0
+    swap_report = None
+    swap_compile_delta = {}
+    swap_phase_s = 0.0
+    if loop is not None:
+        t_active = time.perf_counter()
+        loop.pump()                      # evaluate phase-1 escalations
+        # The swap burst uses a UNIFORM-size pool (jittered copies of one
+        # base cell): every batch a replica can assemble from it is
+        # (rung(B * n_atoms), B) for some B <= max_batch — a bucket set
+        # small enough to warm EXHAUSTIVELY. Warm it per alive replica
+        # with direct engine bursts at EVERY batch size 1..max_batch
+        # (drain between bursts pins the assembled B; each B has its own
+        # total-atom rung), so the delta below measures only what the
+        # swap itself would cost: zero, by the pure-pytree-swap
+        # contract, however the router splits the burst.
+        swap_pool = []
+        for i in range(n_uniq):
+            a = base_pool[0].copy()
+            a.positions = a.positions + rng.normal(0, 0.01,
+                                                   a.positions.shape)
+            swap_pool.append(a)
+        b_sizes = list(range(1, args.max_batch + 1))
+        for rep in router.replicas.values():
+            if not rep.alive:
+                continue
+            for b in b_sizes:
+                warm = [rep.engine.submit(a) for a in swap_pool[:b]]
+                rep.engine.drain(timeout=120)
+                for f in warm:
+                    f.result(timeout=300)
+        compile_at_swap = {
+            rid: r["compile_count"]
+            for rid, r in router.snapshot()["replicas"].items()}
+        import jax
+
+        key2 = jax.random.PRNGKey(2)
+        new_params = jax.tree.map(
+            lambda x: x + 1e-3 * jax.random.normal(
+                jax.random.fold_in(key2, 1), np.shape(x),
+                np.asarray(x).dtype)
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else x,
+            params)
+        for i, a in enumerate(swap_pool):
+            if i == max(n_uniq // 4, 1) and swap_report is None:
+                # mid-burst: earlier submissions are queued/in flight
+                swap_report = loop.swap_now(new_params)
+            swap_futs.append(loop.submit(a))
+        if swap_report is None:          # tiny bursts: swap after the loop
+            swap_report = loop.swap_now(new_params)
+        for f in swap_futs:
+            try:
+                f.result(timeout=300)
+                swap_ok += 1
+            except Exception:  # noqa: BLE001
+                failed += 1
+        router.drain(timeout=120)
+        loop.pump()
+        swap_compile_delta = {
+            rid: r["compile_count"] - compile_at_swap.get(rid, 0)
+            for rid, r in router.snapshot()["replicas"].items()}
+        swap_phase_s = time.perf_counter() - t_active
+
+    snap = router.snapshot()
     compile_total = sum(r["compile_count"]
                         for r in snap["replicas"].values())
     lats.sort()
@@ -389,6 +504,15 @@ def run_fleet(args) -> int:
         "replicas": snap["replicas"],
         "cache": snap["cache"],
     }
+    if loop is not None:
+        summary["active"] = {
+            **loop.snapshot(),
+            "swap_burst_requests": len(swap_futs),
+            "swap_burst_ok": swap_ok,
+            "swap_compile_delta": swap_compile_delta,
+            "swap_phase_s": round(swap_phase_s, 3),
+            "model_id": router.model_id,
+        }
     if args.jsonl:
         summary["jsonl"] = args.jsonl
     rc = 0
@@ -408,6 +532,16 @@ def run_fleet(args) -> int:
         }
         if args.chaos == "kill-replica":
             checks["failover_observed"] = snap["stats"]["failovers"] >= 1
+        if loop is not None:
+            # the hot-swap contract: a mid-burst swap loses ZERO requests
+            # and triggers ZERO recompiles on any replica
+            checks["active_all_resolved"] = all(f.done() for f in swap_futs)
+            checks["active_zero_lost"] = swap_ok == len(swap_futs)
+            checks["active_no_swap_recompiles"] = all(
+                d == 0 for d in swap_compile_delta.values())
+            checks["active_model_id_rolled"] = router.model_id != args.model
+            checks["active_escalations_evaluated"] = \
+                loop.stats.evaluated > 0
         summary["checks"] = checks
         if not all(checks.values()):
             summary["check"] = "FAIL"
@@ -451,6 +585,12 @@ def main(argv=None) -> int:
                    help="run FLEET mode instead: N in-process ServeEngine "
                         "replicas behind a FleetRouter (tenant fairness, "
                         "result cache, failover)")
+    p.add_argument("--active", action="store_true",
+                   help="fleet mode: attach an ActiveLoop (sampled "
+                        "ensemble escalation into a replay buffer) and "
+                        "run a second burst with a mid-burst hot-swap; "
+                        "--check gates zero lost requests and zero "
+                        "recompiles across the swap")
     p.add_argument("--chaos", choices=("none", "kill-replica"),
                    default="none",
                    help="fleet mode: kill replica r0 mid-burst; --check "
@@ -470,6 +610,10 @@ def main(argv=None) -> int:
                         "memory_budget gate); default: backend-reported "
                         "bytes_limit (none on CPU)")
     args = p.parse_args(argv)
+    if args.active and args.fleet < 1:
+        print("usage error: --active requires fleet mode (--fleet N)",
+              file=sys.stderr)
+        return 2
     if args.fleet > 0:
         return run_fleet(args)
     return run(args)

@@ -32,6 +32,7 @@ learning" section.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
 import time
@@ -39,6 +40,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..obs import runtime as obsrt
 from ..telemetry import StepRecord
 from .buffer import ReplayBuffer
 from .hotswap import hot_swap, params_digest
@@ -134,6 +136,11 @@ class ActiveLoop:
                 while len(self._pending) > self.policy.max_pending:
                     self._pending.pop(0)
                     self.stats.escalation_dropped += 1
+        if decide:
+            mx = obsrt.metrics()
+            if mx is not None:
+                mx.counter("distmlip_active_escalations_total",
+                           "requests routed to ensemble evaluation").inc()
         return fut
 
     @property
@@ -161,7 +168,13 @@ class ActiveLoop:
         return done
 
     def _evaluate_batch(self, batch) -> int:
-        results = self.ensemble.calculate_with_variance(batch)
+        tr = obsrt.tracer()
+        # its own (batch-level) trace; the ensemble's vmapped record
+        # stamps these ids via the ambient context
+        with (tr.span("active.escalate", new_trace=True,
+                      attrs={"batch_size": len(batch)})
+              if tr is not None else contextlib.nullcontext()):
+            results = self.ensemble.calculate_with_variance(batch)
         scores = []
         added = 0
         for atoms, res in zip(batch, results):
@@ -181,6 +194,13 @@ class ActiveLoop:
         with self._lock:
             self.stats.evaluated += len(batch)
             self.stats.buffered += added
+        mx = obsrt.metrics()
+        if mx is not None:
+            mx.counter("distmlip_active_evaluated_total",
+                       "structures re-evaluated under the ensemble").inc(
+                           len(batch))
+            mx.gauge("distmlip_active_buffer_size",
+                     "replay-buffer depth").set(len(self.buffer))
         self._emit("active_escalate", batch_size=len(batch), extra={
             "variances": [round(float(s), 9) for s in scores],
             "buffer_added": added,
@@ -242,13 +262,20 @@ class ActiveLoop:
         ensemble's primary member. Zero recompiles (asserted inside
         :mod:`~.hotswap`), zero dropped requests, result/AOT cache keys
         rolled forward on a router."""
-        swap = hot_swap(self.serving, new_params)
+        tr = obsrt.tracer()
+        with (tr.span("active.hotswap", new_trace=True)
+              if tr is not None else contextlib.nullcontext()):
+            swap = hot_swap(self.serving, new_params)
         # a standalone evaluator (not the engine's own potential) needs
         # its primary rolled too; set_primary is idempotent when the
         # engine swap already installed the weights
         self.ensemble.set_primary(new_params)
         with self._lock:
             self.stats.swaps += 1
+        mx = obsrt.metrics()
+        if mx is not None:
+            mx.counter("distmlip_active_swaps_total",
+                       "zero-recompile hot swaps shipped").inc()
         self._emit("active_swap", extra={
             "swap_count": self.stats.swaps,
             "model_digest": params_digest(new_params),

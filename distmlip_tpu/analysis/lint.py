@@ -14,6 +14,15 @@ the *source*:
 - **DML002 wallclock-in-jit**: ``time.time()`` / ``time.perf_counter()``
   inside a function that gets jitted — the value is baked at trace time,
   so the "timestamp" is a constant from the first call.
+- **DML003 span-in-jit**: host span/annotation helpers
+  (``tracer.span(...)``, ``start_request``, ``emit``-style span
+  creation from :mod:`distmlip_tpu.obs`, ``telemetry.annotate`` /
+  ``jax.profiler.TraceAnnotation``) inside a jitted/device function.
+  Host tracing in a traced region runs once at TRACE time — the span
+  measures compilation, not execution — and anything that makes the
+  traced function observe host state is a silent recompile hazard.
+  ``jax.named_scope`` / ``telemetry.scope`` are exempt: they only attach
+  metadata to the HLO.
 - **F401 unused-import** (ruff-compatible code): module-level imports
   never referenced (dunder-all re-exports and ``import x as x``
   re-export idiom respected). The one pyflakes rule worth enforcing
@@ -44,6 +53,13 @@ from .findings import Finding, Severity, apply_suppressions
 HOT_MODULE_DIRS = ("models", "ops", "parallel")
 
 _TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time"}
+
+# span-creating helper names (distmlip_tpu.obs.Tracer surface +
+# telemetry.annotate / jax.profiler.TraceAnnotation). Deliberately NOT
+# "scope"/"named_scope": those are trace-time metadata only and belong
+# inside jitted code.
+_SPAN_FUNCS = {"span", "start_request", "adopt_request", "finish_request",
+               "begin", "annotate", "TraceAnnotation", "start_trace"}
 
 
 def _dotted(node) -> str:
@@ -128,6 +144,20 @@ def _lint_device_fn(fn, path: str, in_hot_module: bool) -> list:
             emit(node, "DML002",
                  f"{callee}() inside a jitted function is baked at trace "
                  "time — hoist it to the host caller")
+            continue
+        # DML003 applies to every device fn too: span creation is a
+        # HOST action — in a traced region it fires once at trace time
+        # (measuring compilation, not steps) and is a recompile hazard
+        leaf = callee.split(".")[-1] if callee else \
+            (node.func.attr if isinstance(node.func, ast.Attribute)
+             else "")
+        if leaf in _SPAN_FUNCS:
+            emit(node, "DML003",
+                 f"{callee or leaf}() creates a host span/annotation "
+                 "inside a jitted/device function — host tracing in a "
+                 "traced region runs at trace time only and risks "
+                 "silent recompiles; hoist it to the host caller "
+                 "(named_scope/scope is the in-jit alternative)")
             continue
         if not in_hot_module:
             continue

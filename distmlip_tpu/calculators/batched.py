@@ -28,9 +28,11 @@ import time
 
 import numpy as np
 
+from ..obs import runtime as obsrt
 from ..parallel import make_batched_potential_fn
 from ..partition import BucketPolicy, pack_structures
 from ..telemetry import StepRecord, annotate
+from ..telemetry.trace import tracing_enabled
 from .atoms import (AMU_A2_FS2_TO_EV, EV_A3_TO_GPA, KB, map_species,
                     max_displacement)
 from .relax import RelaxResult
@@ -425,7 +427,16 @@ class BatchedPotential:
     def _calculate_locked(self, structures) -> list:
         graph, host, positions, reused, refreshed, rebuild_s, \
             (t0, t1, t2) = self._prepare_batch(structures)
-        with annotate("distmlip/batched_potential"):
+        # when an xprof capture is live, fold the ambient obs trace id
+        # into the TraceAnnotation name so the device timeline lines up
+        # with the host span tree (name built only when tracing is on —
+        # the disabled path stays allocation-free)
+        ann_name = "distmlip/batched_potential"
+        if tracing_enabled():
+            tid = obsrt.current_trace_id()
+            if tid is not None:
+                ann_name = f"{ann_name}[trace={tid}]"
+        with annotate(ann_name):
             from ..kernels.dispatch import counting
 
             with counting() as kc:
@@ -518,8 +529,14 @@ class BatchedPotential:
         cache_size = self.compile_count
         compiled = cache_size > self._last_compile_count
         self._last_compile_count = cache_size
+        # correlate with the obs plane: under a ServeEngine dispatch the
+        # ambient context is the serve.batch span, so this record and the
+        # exported span tree share ids
+        ctx = obsrt.current_ctx()
         rec = StepRecord(
             step=self._step_counter, kind=kind, member_count=member_count,
+            trace_id=ctx[0] if ctx is not None else "",
+            span_id=ctx[1] if ctx is not None else "",
             timings=dict(self.last_timings),
             compile_cache_size=cache_size, compiled=compiled,
             graph_reused=reused, rebuild=not reused,

@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..obs import runtime as obsrt
 from ..utils.health import ReprobePolicy
 
 
@@ -110,6 +111,19 @@ class ReplicaHealth:
             verdict = self._policy(rid).observe(
                 replica.healthy(self.stall_budget_s))
             verdicts[rid] = verdict
+            if verdict in ("suspect", "wedged"):
+                # first wedge SUSPICION is already flight-recorder
+                # material: by the time the wedge is confirmed and the
+                # failover reclaims the queue, the interesting state
+                # (span trees of the stalled requests, queue-depth
+                # gauges) is gone. Rate-limited inside the recorder.
+                fl = obsrt.flight()
+                if fl is not None:
+                    fl.capture(
+                        f"replica {rid} {verdict}: no dispatch progress "
+                        f"within {self.stall_budget_s:.0f}s",
+                        attrs={"replica": rid,
+                               **replica.health_snapshot()})
             if verdict == "wedged":
                 alive_others = any(
                     r.alive for other_id, r in self.router.replicas.items()

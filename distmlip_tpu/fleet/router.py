@@ -43,6 +43,7 @@ import time
 from concurrent.futures import Future
 from dataclasses import dataclass
 
+from ..obs import runtime as obsrt
 from ..serve.engine import EngineClosed, ServeRejected
 from ..telemetry import StepRecord
 from .replica import Replica
@@ -62,10 +63,10 @@ class _Routed:
 
     __slots__ = ("atoms", "properties", "priority", "deadline_abs",
                  "tenant", "future", "key", "t_submit", "attempts",
-                 "current", "replica_id", "done", "waiters")
+                 "current", "replica_id", "done", "waiters", "trace")
 
     def __init__(self, atoms, properties, priority, deadline_abs, tenant,
-                 key, t_submit):
+                 key, t_submit, trace=None):
         self.atoms = atoms
         self.properties = properties
         self.priority = priority
@@ -78,7 +79,10 @@ class _Routed:
         self.current = None          # authoritative engine Future
         self.replica_id = ""
         self.done = False
-        self.waiters: list[tuple[Future, float]] = []   # coalesced callers
+        # coalesced callers: (future, submit time, RequestTrace | None) —
+        # each carries its OWN request trace, resolved when this one is
+        self.waiters: list[tuple[Future, float, object]] = []
+        self.trace = trace           # obs RequestTrace (router-owned root)
 
 
 @dataclass
@@ -161,6 +165,13 @@ class FleetRouter:
         self._closed = False
         self._step_counter = itertools.count(1)
         self._rr = 0    # round-robin tie-break cursor
+        mx = obsrt.metrics()
+        if mx is not None:
+            alive = mx.gauge("distmlip_replica_alive",
+                             "replica liveness (1 = serving)",
+                             labels=("replica",))
+            for rid in self.replicas:
+                alive.labels(replica=rid).set(1)
 
     # ------------------------------------------------------------------
     # submission
@@ -174,6 +185,15 @@ class FleetRouter:
         when the tenant is over its admission quota and ``EngineClosed``
         after ``close()``."""
         now = self._clock()
+        tr = obsrt.tracer()
+        mx = obsrt.metrics()
+        # one ROOT span per submission — cache hits and coalesced
+        # duplicates get their own (short) trace too, so span-tree count
+        # is conserved: N submissions in, N future.resolve terminals out
+        trace = (tr.start_request("fleet.submit",
+                                  attrs={"tenant": tenant,
+                                         "n_atoms": len(atoms)})
+                 if tr is not None else None)
         key = (cache_key(atoms, self.model_id, properties, self.precision,
                          tol=self.cache_tol)
                if self.cache is not None else None)
@@ -184,28 +204,63 @@ class FleetRouter:
         if hit is not None:
             with self._cv:
                 if self._closed:
+                    # "rejected" = closed-without-a-Future: the span
+                    # gate exempts these roots from the terminal rule
+                    self._trace_abort(trace, "rejected")
                     raise EngineClosed("submit() on a closed router")
                 self.stats.cache_hits += 1
+            if mx is not None:
+                self._count_request(mx, tenant)
+                mx.counter("distmlip_fleet_cache_hits_total",
+                           "submissions served from the result cache"
+                           ).inc()
             fut = Future()
+            if tr is not None:
+                tr.emit("cache.hit", parent=trace.ctx,
+                        t_start=trace.t_submit)
+                tr.finish_request(trace, "ok")
             fut.set_result(hit)
-            self._emit(tenant, "", [0.0], cache_hit=True)
+            self._emit(tenant, "", [0.0], cache_hit=True, trace=trace)
             return fut
         with self._cv:
             if self._closed:
+                self._trace_abort(trace, "error")
                 raise EngineClosed("submit() on a closed router")
             if key is not None:
                 routed = self._inflight_by_key.get(key)
                 if routed is not None and not routed.done:
                     # identical request already computing: coalesce
                     fut = Future()
-                    routed.waiters.append((fut, now))
+                    routed.waiters.append((fut, now, trace))
                     self.stats.coalesced += 1
+                    if mx is not None:
+                        self._count_request(mx, tenant)
+                        mx.counter(
+                            "distmlip_fleet_coalesced_total",
+                            "submissions coalesced onto an in-flight "
+                            "computation").inc()
                     return fut
+            t_adm = tr.now() if tr is not None else 0.0
             if not self._sched.admit(tenant):
                 self.stats.quota_rejected += 1
+                if mx is not None:
+                    mx.counter("distmlip_fleet_quota_rejects_total",
+                               "submissions rejected at the tenant "
+                               "quota door", labels=("tenant",)
+                               ).labels(tenant=tenant).inc()
+                if tr is not None:
+                    tr.emit("tenancy.admit", parent=trace.ctx,
+                            t_start=t_adm, status="rejected",
+                            attrs={"tenant": tenant})
+                    # rejected at the door: the root closes WITHOUT a
+                    # terminal (no Future was ever handed out)
+                    tr.end(trace.root, status="rejected")
                 raise ServeRejected(
                     f"tenant {tenant!r} is over its admission quota "
                     f"(token bucket empty); retry later")
+            if tr is not None:
+                tr.emit("tenancy.admit", parent=trace.ctx, t_start=t_adm,
+                        attrs={"tenant": tenant})
             routed = _Routed(
                 atoms=atoms,
                 properties=(tuple(properties) if properties is not None
@@ -213,13 +268,33 @@ class FleetRouter:
                 priority=int(priority),
                 deadline_abs=(now + float(deadline)
                               if deadline is not None else None),
-                tenant=tenant, key=key, t_submit=now)
+                tenant=tenant, key=key, t_submit=now, trace=trace)
             self.stats.submitted += 1
+            if mx is not None:
+                self._count_request(mx, tenant)
+                mx.gauge("distmlip_tenant_queue_depth",
+                         "requests queued per tenant",
+                         labels=("tenant",)).labels(tenant=tenant).set(
+                             self._sched.queued(tenant) + 1)
             if key is not None:
                 self._inflight_by_key[key] = routed
             self._sched.enqueue(tenant, routed)
         self._pump()
         return routed.future
+
+    @staticmethod
+    def _count_request(mx, tenant: str) -> None:
+        mx.counter("distmlip_fleet_requests_total",
+                   "submissions accepted per tenant (routed, cache hits "
+                   "and coalesced alike)", labels=("tenant",)
+                   ).labels(tenant=tenant).inc()
+
+    @staticmethod
+    def _trace_abort(trace, status: str) -> None:
+        """Close a root whose submission raised before a Future existed."""
+        tr = obsrt.tracer()
+        if tr is not None and trace is not None and trace.root is not None:
+            tr.end(trace.root, status=status)
 
     # ------------------------------------------------------------------
     # dispatch
@@ -240,15 +315,28 @@ class FleetRouter:
                 best = rep
         return best
 
+    def _refresh_tenant_gauges_locked(self) -> None:
+        """Sync the per-tenant queue-depth gauges with the scheduler
+        (called when the pump runs dry — the backlog just changed)."""
+        mx = obsrt.metrics()
+        if mx is None:
+            return
+        gauge = mx.gauge("distmlip_tenant_queue_depth",
+                         "requests queued per tenant", labels=("tenant",))
+        for name, depth in self._sched.queue_depths().items():
+            gauge.labels(tenant=name).set(depth)
+
     def _pump(self) -> None:
         """Dispatch while a replica slot and a fair pick both exist."""
         while True:
             with self._cv:
                 rep = self._pick_replica_locked()
                 if rep is None:
+                    self._refresh_tenant_gauges_locked()
                     return
                 nxt = self._sched.pop()
                 if nxt is None:
+                    self._refresh_tenant_gauges_locked()
                     return
                 _tenant, routed = nxt
                 if routed.done:
@@ -261,20 +349,47 @@ class FleetRouter:
         deadline = None
         if routed.deadline_abs is not None:
             deadline = max(routed.deadline_abs - self._clock(), 1e-3)
+        tr = obsrt.tracer()
+        route_span = None
+        if tr is not None and routed.trace is not None:
+            # retroactive tenant-queue wait: submit -> this dispatch
+            # attempt (a failover re-dispatch re-covers from the original
+            # submit — the critical-path union handles the overlap)
+            tr.emit("router.queue", parent=routed.trace.ctx,
+                    t_start=routed.trace.t_submit,
+                    attrs={"tenant": routed.tenant,
+                           "attempt": routed.attempts})
+            route_span = tr.begin(
+                "router.route", parent=routed.trace.ctx,
+                attrs={"replica": rep.replica_id,
+                       "attempt": routed.attempts})
         try:
-            fut = rep.engine.submit(
-                routed.atoms, properties=routed.properties,
-                priority=routed.priority, deadline=deadline)
+            if route_span is not None:
+                # ambient context hands the request trace to the engine:
+                # its engine.queue span parents under this route span
+                with tr.use(route_span):
+                    fut = rep.engine.submit(
+                        routed.atoms, properties=routed.properties,
+                        priority=routed.priority, deadline=deadline)
+                tr.end(route_span)
+            else:
+                fut = rep.engine.submit(
+                    routed.atoms, properties=routed.properties,
+                    priority=routed.priority, deadline=deadline)
         except EngineClosed:
             # the replica died between the pick and the submit: put the
             # request back at the head of its tenant queue and retry on
             # a survivor
+            if route_span is not None:
+                tr.end(route_span, status="engine_closed")
             with self._cv:
                 rep.outstanding -= 1
             self._note_dead(rep, reason="engine closed under dispatch")
             self._requeue(routed)
             return
         except Exception as e:  # noqa: BLE001 - explicit per-request error
+            if route_span is not None:
+                tr.end(route_span, status="error")
             with self._cv:
                 rep.outstanding -= 1
             self._finish(routed, exc=e)
@@ -353,6 +468,15 @@ class FleetRouter:
                 self.stats.redispatches += 1
                 self._sched.enqueue(routed.tenant, routed, front=True)
                 exc = None
+        tr = obsrt.tracer()
+        if tr is not None and routed.trace is not None:
+            tr.emit("router.requeue", parent=routed.trace.ctx,
+                    status="ok" if exc is None else "exhausted",
+                    attrs={"attempt": routed.attempts})
+        mx = obsrt.metrics()
+        if mx is not None and exc is None:
+            mx.counter("distmlip_fleet_redispatches_total",
+                       "failover re-dispatches").inc()
         if exc is not None:
             self._finish(routed, exc=exc)
         else:
@@ -381,21 +505,55 @@ class FleetRouter:
             else:
                 self.stats.failed += 1 + len(waiters)
             now = self._clock()
-            lats = [now - routed.t_submit] + [now - t for _, t in waiters]
+            lats = [now - routed.t_submit] + [now - t for _, t, _w in
+                                             waiters]
             self._cv.notify_all()
+        status = "ok" if exc is None else "error"
+        # terminal spans BEFORE resolution: a caller returning from
+        # Future.result() must already see its complete span tree
+        tr = obsrt.tracer()
+        if tr is not None:
+            if routed.trace is not None:
+                tr.finish_request(routed.trace, status,
+                                  attrs={"replica": routed.replica_id})
+            for _fut, _t, wtrace in waiters:
+                if wtrace is not None:
+                    tr.emit("coalesce", parent=wtrace.ctx,
+                            t_start=wtrace.t_submit,
+                            links=((routed.trace.ctx,)
+                                   if routed.trace is not None else ()))
+                    tr.finish_request(wtrace, status)
+        mon = obsrt.slo()
+        if mon is not None:
+            for x in lats:
+                mon.observe(routed.tenant, x, ok=exc is None)
+        mx = obsrt.metrics()
+        if mx is not None:
+            name = ("distmlip_fleet_completed_total" if exc is None
+                    else "distmlip_fleet_failed_total")
+            mx.counter(name, "resolved fleet requests per tenant",
+                       labels=("tenant",)).labels(
+                           tenant=routed.tenant).inc(1 + len(waiters))
+            hist = mx.histogram("distmlip_fleet_request_latency_seconds",
+                                "submit-to-resolve latency per tenant",
+                                labels=("tenant",)).labels(
+                                    tenant=routed.tenant)
+            for x in lats:
+                hist.observe(x)
         # resolution + telemetry outside the lock: done-callbacks and
         # sink writes must not serialize every replica's completions
         if exc is None:
             routed.future.set_result(result)
-            for fut, _t in waiters:
+            for fut, _t, _w in waiters:
                 # each coalesced caller gets its OWN copy: one caller
                 # mutating a forces array must not corrupt another's
                 fut.set_result(_copy_result(result))
         else:
             routed.future.set_exception(exc)
-            for fut, _t in waiters:
+            for fut, _t, _w in waiters:
                 fut.set_exception(exc)
-        self._emit(routed.tenant, routed.replica_id, lats, cache_hit=False)
+        self._emit(routed.tenant, routed.replica_id, lats, cache_hit=False,
+                   trace=routed.trace)
 
     # ------------------------------------------------------------------
     # failover / chaos
@@ -408,6 +566,22 @@ class FleetRouter:
             rep.alive = False
             self.stats.failovers += 1
             self._cv.notify_all()
+        self._obs_failover(rep.replica_id, reason)
+
+    @staticmethod
+    def _obs_failover(replica_id: str, reason: str) -> None:
+        mx = obsrt.metrics()
+        if mx is not None:
+            mx.counter("distmlip_fleet_failovers_total",
+                       "replicas failed over").inc()
+            mx.gauge("distmlip_replica_alive",
+                     "replica liveness (1 = serving)",
+                     labels=("replica",)).labels(replica=replica_id).set(0)
+        fl = obsrt.flight()
+        if fl is not None:
+            fl.capture(f"replica {replica_id} failed over: "
+                       f"{reason or 'unspecified'}",
+                       attrs={"replica": replica_id})
 
     def fail_over(self, replica_id: str, reason: str = "",
                   reclaim_inflight: bool = True) -> int:
@@ -428,6 +602,7 @@ class FleetRouter:
                 return 0
             rep.alive = False
             self.stats.failovers += 1
+        self._obs_failover(replica_id, reason)
         # (1) requests still queued on the engine: their Futures are
         # unresolved by extract_pending's contract, so reclaiming is the
         # ONLY way they ever resolve
@@ -553,7 +728,8 @@ class FleetRouter:
         return out
 
     def _emit(self, tenant: str, replica_id: str,
-              latencies: list[float], cache_hit: bool) -> None:
+              latencies: list[float], cache_hit: bool,
+              trace=None) -> None:
         """Emit one fleet_request record. Called OUTSIDE the router lock
         (sink writes must not serialize completions); the step counter is
         its own atomic source. ``aot_rehydrated`` is deliberately NOT set
@@ -568,6 +744,8 @@ class FleetRouter:
         rec = StepRecord(
             step=next(self._step_counter), kind="fleet_request",
             timings={"total_s": max(latencies)},
+            trace_id=trace.trace_id if trace is not None else "",
+            span_id=trace.span_id if trace is not None else "",
             tenant=tenant, replica_id=replica_id, cache_hit=cache_hit,
             batch_size=len(latencies),
             request_latency_s=[round(x, 6) for x in latencies],

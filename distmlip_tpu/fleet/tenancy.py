@@ -175,6 +175,12 @@ class FairScheduler:
         st = self._tenants.get(name)
         return len(st.queue) if st is not None else 0
 
+    def queue_depths(self) -> dict[str, int]:
+        """Per-tenant backlog snapshot (the router's live queue-depth
+        gauges read this under its own lock)."""
+        return {name: len(st.queue)
+                for name, st in self._tenants.items()}
+
     def stats(self) -> dict:
         return {name: {"weight": st.weight,
                        "submitted": st.submitted,

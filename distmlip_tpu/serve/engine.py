@@ -40,6 +40,19 @@ Telemetry: each dispatched batch emits a ``StepRecord`` (kind
 latency lists, queue depth, batch occupancy and cumulative reject /
 deadline-miss counters — rendered by ``telemetry_report``'s "serving"
 section.
+
+Observability (:mod:`distmlip_tpu.obs`): with a hub installed, every
+request grows a span tree — ``engine.submit`` root (standalone) or the
+router's ambient context (fleet), a retroactive ``engine.queue`` span at
+dispatch, a batch-level ``serve.batch`` trace (plan/pack/compile/device
+children) LINKED to every member request, and exactly one terminal
+``future.resolve`` per request, whatever path it took (dispatch, shed,
+over-budget fail, poison isolation, non-draining close). The layer that
+OPENED the root closes it: a router-adopted request's terminal is the
+router's to emit. Metrics (queue depth, batch occupancy, service
+histogram, compiles, rejects/sheds) ride the same points. With no hub
+installed each site costs one global read — the disabled hot path is
+unchanged.
 """
 
 from __future__ import annotations
@@ -54,6 +67,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs import runtime as obsrt
 from ..telemetry import StepRecord
 from .scheduler import plan_batch
 
@@ -84,6 +98,12 @@ class _Request:
     future: Future = field(compare=False, default_factory=Future)
     t_submit: float = field(compare=False, default=0.0)
     n_atoms: int = field(compare=False, default=0)
+    # observability handle (obs.tracing.RequestTrace): the request's span
+    # context, carried across the submitter -> scheduler thread hop. When
+    # its .root is set the ENGINE owns the trace (standalone submit) and
+    # emits the terminal future.resolve; under a FleetRouter the root
+    # lives router-side and this holds only the adopted context.
+    trace: object = field(compare=False, default=None, repr=False)
 
 
 @dataclass
@@ -239,6 +259,7 @@ class ServeEngine:
         # empty queue) — the wedge-detection signal health_snapshot serves
         self._last_progress = self._clock()
         self._step = 0
+        self._last_plan_attrs: dict | None = None   # obs plan-span attrs
         self._thread: threading.Thread | None = None
         if start:
             self.start()
@@ -358,6 +379,7 @@ class ServeEngine:
                 while self._pending:
                     req = heapq.heappop(self._pending)
                     if req.future.set_running_or_notify_cancel():
+                        self._trace_terminal(req, "error")
                         req.future.set_exception(EngineClosed(
                             "engine closed before this request was "
                             "dispatched"))
@@ -407,13 +429,23 @@ class ServeEngine:
             t_submit=now,
             n_atoms=len(atoms),
         )
+        mx = obsrt.metrics()
         with self._cv:
             if self._closed:
                 raise EngineClosed("submit() on a closed engine")
-            self._check_hbm_admission(atoms)
+            try:
+                self._check_hbm_admission(atoms)
+            except ServeRejected:
+                if mx is not None:
+                    mx.counter("distmlip_serve_rejected_total",
+                               "admission-rejected requests").inc()
+                raise
             if len(self._pending) >= self.max_queue:
                 if self.admission == "reject":
                     self.stats.rejected += 1
+                    if mx is not None:
+                        mx.counter("distmlip_serve_rejected_total",
+                                   "admission-rejected requests").inc()
                     raise ServeRejected(
                         f"queue full ({self.max_queue} pending); retry later "
                         f"or construct with admission='block'")
@@ -424,7 +456,23 @@ class ServeEngine:
                     raise EngineClosed("engine closed while blocked on "
                                        "admission")
             self.stats.submitted += 1
+            tr = obsrt.tracer()
+            if tr is not None:
+                # adopt an ambient (router-owned) request trace, or open
+                # a root of our own for standalone submissions
+                req.trace = tr.adopt_request()
+                if req.trace is None:
+                    req.trace = tr.start_request(
+                        "engine.submit",
+                        attrs={"n_atoms": req.n_atoms,
+                               "priority": req.priority})
             heapq.heappush(self._pending, req)
+            if mx is not None:
+                mx.counter("distmlip_serve_submitted_total",
+                           "accepted engine submissions").inc()
+                mx.gauge("distmlip_serve_queue_depth",
+                         "requests queued, not yet dispatched").set(
+                             len(self._pending))
             self._cv.notify_all()
         return req.future
 
@@ -492,12 +540,17 @@ class ServeEngine:
                 if not ready:
                     self._cv.wait(timeout=self._wait_timeout(now - oldest))
                     continue
+                tr = obsrt.tracer()
+                t_plan0 = tr.now() if tr is not None else 0.0
                 batch, oversized, overbudget, shed = \
                     self._assemble_locked(now)
+                plan_win = ((t_plan0, tr.now())
+                            if tr is not None else None)
                 self._inflight += 1
                 self._cv.notify_all()   # admission slots freed
             try:
-                self._run_dispatch(batch, oversized, overbudget, shed, now)
+                self._run_dispatch(batch, oversized, overbudget, shed, now,
+                                   plan_win)
             except BaseException:  # noqa: BLE001 - the loop must survive
                 self.stats.scheduler_errors += 1
                 import traceback
@@ -551,11 +604,13 @@ class ServeEngine:
                 normal.append(r)
         batch: list[_Request] = []
         overbudget: list[_Request] = []
+        self._last_plan_attrs = None
         if normal:
             plan = plan_batch([r.n_atoms for r in normal],
                               policy=getattr(self.potential, "caps", None),
                               max_batch=self.max_batch, window=limit,
                               bytes_budget=self._hbm_budget())
+            self._last_plan_attrs = plan.span_attrs()
             chosen = set(plan.take)
             for i, r in enumerate(normal):
                 if i in chosen:
@@ -575,16 +630,21 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def _run_dispatch(self, batch, oversized, overbudget, shed,
-                      t_dispatch) -> None:
+                      t_dispatch, plan_win=None) -> None:
+        mx = obsrt.metrics()
         for req in shed:
             # outside the lock (done-callbacks run here). Shed requests
             # were healthy at admission and expired in the queue: they
             # count in shed_count, not in failed/deadline_misses
             for r in self._start_requests([req]):
                 self.stats.shed_count += 1
+                if mx is not None:
+                    mx.counter("distmlip_serve_shed_total",
+                               "deadline-shed requests").inc()
                 why = ("has already passed" if r.deadline_abs <= t_dispatch
                        else "provably cannot be met at the current queue "
                             "drain rate")
+                self._trace_terminal(r, "shed")
                 r.future.set_exception(ServeRejected(
                     f"deadline shed: the request's deadline {why} (queue "
                     f"wait {t_dispatch - r.t_submit:.3f}s); retry with a "
@@ -602,7 +662,7 @@ class ServeEngine:
         for req in oversized:
             self._run_fallback(req, t_dispatch)
         if batch:
-            self._run_batch(batch, t_dispatch)
+            self._run_batch(batch, t_dispatch, plan_win)
 
     def _start_requests(self, requests) -> list[_Request]:
         """Transition Futures to running; drop the ones a caller already
@@ -613,19 +673,53 @@ class ServeEngine:
                 live.append(r)
             else:
                 self.stats.cancelled += 1
+                self._trace_terminal(r, "cancelled")
         return live
+
+    def _trace_terminal(self, req: _Request, status: str) -> None:
+        """Close an ENGINE-OWNED request trace with its one terminal
+        ``future.resolve`` span (no-op for router-owned traces — the
+        router closes those when the caller-visible Future resolves)."""
+        if req.trace is None:
+            return
+        tr = obsrt.tracer()
+        if tr is not None:
+            tr.finish_request(req.trace, status=status)
 
     def _resolve(self, req: _Request, result: dict, t_done: float) -> None:
         if req.deadline_abs < t_done:
             self.stats.deadline_misses += 1
+            fl = obsrt.flight()
+            if fl is not None:
+                # first deadline miss = incident (rate-limited inside):
+                # the flight recorder captures traces + metrics while the
+                # regression is still on the wire
+                fl.capture("serve deadline miss", attrs={
+                    "queue_wait_s": round(t_done - req.t_submit, 6),
+                    "n_atoms": req.n_atoms,
+                    "deadline_misses": self.stats.deadline_misses})
+            mx = obsrt.metrics()
+            if mx is not None:
+                mx.counter("distmlip_serve_deadline_miss_total",
+                           "requests resolved past their deadline").inc()
         if req.properties is not None:
             keep = set(req.properties) | {"energy"}
             result = {k: v for k, v in result.items() if k in keep}
         self.stats.completed += 1
+        mx = obsrt.metrics()
+        if mx is not None:
+            mx.counter("distmlip_serve_completed_total",
+                       "requests resolved with a result").inc()
+        self._trace_terminal(req, "ok")
         req.future.set_result(result)
 
     def _fail(self, req: _Request, exc: BaseException) -> None:
         self.stats.failed += 1
+        mx = obsrt.metrics()
+        if mx is not None:
+            mx.counter("distmlip_serve_failed_total",
+                       "requests resolved with an explicit error").inc()
+        self._trace_terminal(req, "error")
         req.future.set_exception(exc)
 
     def _oversized_lane(self):
@@ -675,6 +769,15 @@ class ServeEngine:
         if not live:
             return
         req = live[0]
+        tr = obsrt.tracer()
+        t_dev0 = 0.0
+        if tr is not None and req.trace is not None:
+            # queue wait + device dispatch ride the request's OWN trace
+            # (no separate batch trace: the oversized lane is B=1)
+            t_dev0 = tr.now()
+            tr.emit("engine.queue", parent=req.trace.ctx,
+                    t_start=req.trace.t_submit, t_end=t_dev0,
+                    attrs={"n_atoms": req.n_atoms, "lane": "oversized"})
         t0 = time.perf_counter()
         try:
             lane = self._oversized_lane()
@@ -700,6 +803,10 @@ class ServeEngine:
             return
         t_done = self._clock()
         self.stats.fallback_requests += 1
+        if tr is not None and req.trace is not None:
+            tr.emit("device.dispatch", parent=req.trace.ctx,
+                    t_start=t_dev0, t_end=tr.now(),
+                    attrs={"lane": "oversized"})
         # deliberately NOT folded into the shedding EWMA: one slow
         # oversized request on the spatial lane would inflate the
         # batched lane's drain estimate and shed healthy deadlines
@@ -709,9 +816,12 @@ class ServeEngine:
         # batches no longer bypass graph/occupancy telemetry
         self._emit_record("serve_fallback", [req], t_dispatch, t_done,
                           service_s=time.perf_counter() - t0,
-                          pot_stats=pot_stats)
+                          pot_stats=pot_stats,
+                          trace_ctx=(req.trace.ctx if req.trace is not None
+                                     else None))
 
-    def _run_batch(self, batch: list[_Request], t_dispatch: float) -> None:
+    def _run_batch(self, batch: list[_Request], t_dispatch: float,
+                   plan_win=None) -> None:
         batch = self._start_requests(batch)
         if not batch:
             return
@@ -726,8 +836,32 @@ class ServeEngine:
                     "non-finite positions (NaN/inf) in submitted structure"))
         if not good:
             return
+        # --- tracing: close each member's queue wait, open the batch
+        # trace with span LINKS back to every member request ---
+        tr = obsrt.tracer()
+        batch_span = None
+        if tr is not None:
+            t_q = tr.now()
+            links = []
+            for r in good:
+                if r.trace is not None:
+                    tr.emit("engine.queue", parent=r.trace.ctx,
+                            t_start=r.trace.t_submit, t_end=t_q,
+                            attrs={"n_atoms": r.n_atoms})
+                    links.append(r.trace.ctx)
+            batch_span = tr.begin(
+                "serve.batch", new_trace=True, links=links,
+                t_start=plan_win[0] if plan_win is not None else t_q,
+                attrs={"batch_size": len(good)})
+            if plan_win is not None:
+                tr.emit("scheduler.plan_batch", parent=batch_span,
+                        t_start=plan_win[0], t_end=plan_win[1],
+                        attrs=self._last_plan_attrs)
         t0 = time.perf_counter()
+        cc_before = self.compile_count
         pot_stats: dict = {}
+        pot_timings: dict = {}
+        t_calc_end = 0.0
         try:
             # snapshot last_stats in the same critical section as the call:
             # a direct caller sharing the potential (or this lane's own
@@ -735,9 +869,19 @@ class ServeEngine:
             # batch executing and the engine reading its occupancy
             lock = getattr(self.potential, "_lock", None)
             with lock if lock is not None else _NULL_CTX:
-                results = self.potential.calculate([r.atoms for r in good])
+                # ambient batch context: the potential's own record
+                # stamps these ids and its TraceAnnotation carries the
+                # trace id, lining device timelines up with host spans
+                with (tr.use(batch_span) if tr is not None
+                      else contextlib.nullcontext()):
+                    results = self.potential.calculate(
+                        [r.atoms for r in good])
                 pot_stats = dict(
                     getattr(self.potential, "last_stats", None) or {})
+                pot_timings = dict(
+                    getattr(self.potential, "last_timings", None) or {})
+            if tr is not None:
+                t_calc_end = tr.now()
         except Exception:  # noqa: BLE001 - isolate per request below
             # a batch-level fault (one request's graph build blowing up the
             # pack) is isolated by re-running each request alone: the
@@ -745,10 +889,20 @@ class ServeEngine:
             results = None
         if results is None:
             for r in good:
+                t_r0 = tr.now() if tr is not None else 0.0
                 try:
                     r_result = self.potential.calculate([r.atoms])[0]
                 except Exception as e:  # noqa: BLE001
-                    self._fail(r, e)
+                    exc: BaseException | None = e
+                else:
+                    exc = None
+                if tr is not None and r.trace is not None:
+                    tr.emit("device.dispatch", parent=r.trace.ctx,
+                            t_start=t_r0, t_end=tr.now(),
+                            status="ok" if exc is None else "error",
+                            attrs={"retry": True})
+                if exc is not None:
+                    self._fail(r, exc)
                 else:
                     self._resolve(r, r_result, self._clock())
             t_done = self._clock()
@@ -756,6 +910,32 @@ class ServeEngine:
             t_done = self._clock()
             for r, res in zip(good, results):
                 self._resolve(r, res, t_done)
+        # diffed AFTER any singles retries: a retry's fresh B=1 bucket
+        # is a real compile and must keep the compiles counter in step
+        # with the compile_count gauge
+        compiled = self.compile_count > cc_before
+        if tr is not None and batch_span is not None:
+            if results is not None and pot_timings.get("total_s"):
+                # reconstruct the pack/device phase windows from the
+                # potential's own perf_counter phase timings, anchored at
+                # the end of the calculate call (same tracer clock)
+                t_c0 = t_calc_end - pot_timings["total_s"]
+                pack_s = (pot_timings.get("neighbor_s", 0.0)
+                          + pot_timings.get("partition_s", 0.0)
+                          + pot_timings.get("rebuild_s", 0.0))
+                tr.emit("batched.pack", parent=batch_span,
+                        t_start=t_c0, t_end=t_c0 + pack_s,
+                        attrs={"bucket_key":
+                               pot_stats.get("bucket_key", "")})
+                tr.emit("device.compile" if compiled
+                        else "device.dispatch", parent=batch_span,
+                        t_start=t_c0 + pack_s,
+                        t_end=t_c0 + pack_s
+                        + pot_timings.get("device_s", 0.0),
+                        attrs={"compiled": compiled})
+            tr.end(batch_span,
+                   status="ok" if results is not None else "error",
+                   attrs={"bucket_key": pot_stats.get("bucket_key", "")})
         service = time.perf_counter() - t0
         self._note_service(service)
         self.stats.batches += 1
@@ -770,9 +950,33 @@ class ServeEngine:
             # batch's occupancy/bucket would corrupt the per-bucket stats
             pot_stats = {}
             occupancy = 0.0
+        mx = obsrt.metrics()
+        if mx is not None:
+            mx.counter("distmlip_serve_batches_total",
+                       "dispatched micro-batches").inc()
+            mx.histogram("distmlip_serve_service_seconds",
+                         "batch service time").observe(service)
+            mx.gauge("distmlip_serve_batch_occupancy",
+                     "real structures / padded batch slots of the last "
+                     "batch").set(occupancy)
+            mx.gauge("distmlip_serve_queue_depth",
+                     "requests queued, not yet dispatched").set(
+                         self.queue_depth)
+            mx.gauge("distmlip_serve_compile_count",
+                     "executables compiled by the shared potential").set(
+                         self.compile_count)
+            if compiled:
+                mx.counter("distmlip_serve_compiles_total",
+                           "batches that triggered an XLA compile").inc()
+            if pot_stats.get("hbm_headroom_frac"):
+                mx.gauge("distmlip_hbm_headroom_frac",
+                         "1 - est_peak_bytes / bytes_limit of the last "
+                         "batch").set(pot_stats["hbm_headroom_frac"])
         self._emit_record("serve_batch", good, t_dispatch, t_done,
                           service_s=service, pot_stats=pot_stats,
-                          batch_occupancy=occupancy)
+                          batch_occupancy=occupancy,
+                          trace_ctx=(batch_span.ctx
+                                     if batch_span is not None else None))
 
     # ------------------------------------------------------------------
     # telemetry
@@ -780,12 +984,15 @@ class ServeEngine:
 
     def _emit_record(self, kind: str, requests, t_dispatch, t_done,
                      service_s: float, pot_stats: dict | None = None,
-                     batch_occupancy: float = 1.0) -> None:
+                     batch_occupancy: float = 1.0,
+                     trace_ctx: tuple | None = None) -> None:
         self._step += 1
         tel = self.telemetry
         if tel is None or not tel.wants_records():
             return
         rec = StepRecord(
+            trace_id=trace_ctx[0] if trace_ctx is not None else "",
+            span_id=trace_ctx[1] if trace_ctx is not None else "",
             step=self._step, kind=kind,
             timings={"service_s": service_s,
                      "total_s": max(t_done - t_dispatch, service_s)},

@@ -51,6 +51,19 @@ class BatchPlan:
     def occupancy(self) -> float:
         return self.total_atoms / self.node_cap if self.node_cap else 0.0
 
+    def span_attrs(self) -> dict:
+        """Attributes for the ``scheduler.plan_batch`` span
+        (distmlip_tpu.obs): how this assembly decision went, visible per
+        batch in the trace timeline instead of only in aggregate."""
+        return {
+            "take": len(self.take),
+            "skipped": len(self.skipped),
+            "total_atoms": self.total_atoms,
+            "node_cap": self.node_cap,
+            "occupancy": round(self.occupancy, 3),
+            "over_budget": self.over_budget,
+        }
+
 
 def plan_batch(
     sizes,

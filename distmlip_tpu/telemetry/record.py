@@ -40,6 +40,12 @@ class StepRecord:
     step: int = 0                    # producer-local step counter
     kind: str = "calculate"          # calculate | md_chunk | relax_step | ...
     t_wall: float = field(default_factory=time.time)  # unix seconds
+    # observability correlation (distmlip_tpu.obs): the trace/span this
+    # record was emitted under — a serve_batch record carries its batch
+    # span, a fleet_request record its request root — so JSONL records
+    # line up with the exported Perfetto span trees ("" = no tracer)
+    trace_id: str = ""
+    span_id: str = ""
 
     # --- per-phase host timings (seconds) ---
     timings: dict[str, float] = field(default_factory=dict)

@@ -237,6 +237,11 @@ class Report:
         if c.get("prefetch_skipped_hbm"):
             out.append(f"prefetch skipped by HBM guard: "
                        f"{c['prefetch_skipped_hbm']} step(s)")
+        if c.get("trace"):
+            from ..obs.export import format_critical_path
+
+            out.append("")
+            out.append(format_critical_path(c["trace"]))
         if self.anomalies:
             out.append("")
             out.append(f"ANOMALIES ({len(self.anomalies)}):")
@@ -721,6 +726,10 @@ def main(argv=None) -> int:
     opts = {"stall_factor": 5.0, "occupancy_floor": 0.35,
             "imbalance_factor": 2.0}
     out_json = None
+    trace_dir = None
+    usage = ("usage: telemetry_report <run.jsonl> [--json out.json] "
+             "[--trace-dir DIR] [--stall-factor F] "
+             "[--occupancy-floor F] [--imbalance-factor F]")
     try:
         for flag in ("--stall-factor", "--occupancy-floor",
                      "--imbalance-factor"):
@@ -732,15 +741,15 @@ def main(argv=None) -> int:
             i = argv.index("--json")
             out_json = argv[i + 1]
             del argv[i:i + 2]
+        if "--trace-dir" in argv:
+            i = argv.index("--trace-dir")
+            trace_dir = argv[i + 1]
+            del argv[i:i + 2]
     except (IndexError, ValueError):
-        print("usage: telemetry_report <run.jsonl> [--json out.json] "
-              "[--stall-factor F] [--occupancy-floor F] "
-              "[--imbalance-factor F]", file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 2
     if len(argv) != 1:
-        print("usage: telemetry_report <run.jsonl> [--json out.json] "
-              "[--stall-factor F] [--occupancy-floor F] "
-              "[--imbalance-factor F]", file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 2
     try:
         records = read_jsonl(argv[0])
@@ -748,6 +757,29 @@ def main(argv=None) -> int:
         print(f"error: cannot read {argv[0]}: {e}", file=sys.stderr)
         return 1
     rep = aggregate(records, **opts)
+    if trace_dir is not None:
+        # per-request critical-path percentiles from exported trace
+        # JSON (distmlip_tpu.obs), rendered next to the per-phase table
+        from ..obs.export import critical_path_summary, load_trace_dir
+
+        try:
+            spans = load_trace_dir(trace_dir)
+        except OSError as e:
+            print(f"error: cannot read {trace_dir}: {e}", file=sys.stderr)
+            return 1
+        summary = critical_path_summary(spans)
+        rep.counters["trace"] = summary
+        if summary.get("queue_dominant"):
+            comps = summary["components"]
+            rep.anomalies.append(Anomaly(
+                "queue_dominant", 0,
+                f"median per-request queue wait "
+                f"{1e3 * comps['queue']['p50']:.1f}ms exceeds median "
+                f"device time "
+                f"{1e3 * (comps['device']['p50'] + comps['compile']['p50']):.1f}ms "
+                f"over {summary['requests']} request(s) — serving is "
+                f"capacity-bound: add replicas / batch slots, faster "
+                f"kernels will not move the p99"))
     print(rep.render())
     if out_json:
         with open(out_json, "w") as f:

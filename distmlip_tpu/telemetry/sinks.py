@@ -130,20 +130,74 @@ class JsonlSink(TelemetrySink):
     emit into one sink concurrently, so each record is serialized OUTSIDE
     the lock and written as one line-atomic ``write`` under it — lines
     never interleave and ``close()`` flushes whatever was emitted.
+
+    Size-bounded rotation: with ``max_bytes`` set, a write that carries
+    the file past the bound closes it and atomically renames it to
+    ``<path>.1`` (existing rotated files shift ``.1 -> .2 -> ...``; at
+    most ``keep`` rotated files survive, the oldest is dropped), then
+    reopens ``path`` fresh — a long ``--fleet`` soak holds at most
+    ``(keep + 1) * max_bytes`` on disk instead of one unbounded file.
+    Rotation never splits a record: the bound is checked AFTER each
+    line-atomic write. ``stats()`` reports lines/bytes/rotations.
     """
 
-    def __init__(self, path: str, mode: str = "w"):
+    def __init__(self, path: str, mode: str = "w",
+                 max_bytes: int | None = None, keep: int = 3):
         if mode not in ("w", "a", "x"):
             raise ValueError(f"mode {mode!r} not in ('w', 'a', 'x')")
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError(f"max_bytes must be > 0, got {max_bytes}")
         self.path = str(path)
+        self.max_bytes = int(max_bytes) if max_bytes is not None else None
+        self.keep = max(1, int(keep))
+        self.rotations = 0
+        self.lines = 0
         self._lock = threading.Lock()
         self._f = open(self.path, mode, buffering=1)
 
     def emit(self, record: StepRecord) -> None:
         line = record.to_json() + "\n"   # serialize outside the lock
         with self._lock:
-            if not self._f.closed:
-                self._f.write(line)
+            if self._f.closed:
+                return
+            self._f.write(line)
+            self.lines += 1
+            if (self.max_bytes is not None
+                    and self._f.tell() >= self.max_bytes):
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        import os
+
+        self._f.flush()
+        self._f.close()
+        # shift .1 -> .2 -> ... (the old .keep is overwritten = dropped)
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "w", buffering=1)
+        self.rotations += 1
+
+    def rotated_paths(self) -> list[str]:
+        """Existing rotated artifacts, newest first (.1, .2, ...)."""
+        import os
+
+        out = []
+        for i in range(1, self.keep + 1):
+            p = f"{self.path}.{i}"
+            if os.path.exists(p):
+                out.append(p)
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"path": self.path, "lines": self.lines,
+                    "rotations": self.rotations,
+                    "bytes_current": (self._f.tell()
+                                      if not self._f.closed else 0),
+                    "max_bytes": self.max_bytes, "keep": self.keep}
 
     def close(self) -> None:
         with self._lock:

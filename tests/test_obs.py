@@ -1,0 +1,601 @@
+"""Observability: tracing, span conservation under failover, metrics,
+SLO burn rates, flight recorder, JSONL rotation, DML003 lint.
+
+The contracts under test:
+
+- every submitted request leaves a CLOSED span tree ending in exactly
+  one ``future.resolve`` terminal — through cache hits, coalesced
+  duplicates, and a mid-burst ``kill_replica()`` (the failover trace-
+  propagation satellite);
+- batch-dispatch spans carry links to every member request, and the
+  per-request critical path (interval union) explains >= 90% of the
+  measured request latency;
+- the metrics registry's Prometheus exposition round-trips the counters
+  the loadgen can verify; the SLO monitor fires on multi-window burn
+  and respects cooldown; the flight recorder writes bounded incidents;
+- ``JsonlSink`` rotation keeps the artifact set bounded without losing
+  or splitting records;
+- span creation inside a jitted function is lint rule DML003.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distmlip_tpu import geometry, obs
+from distmlip_tpu.calculators import Atoms, BatchedPotential
+from distmlip_tpu.fleet import FleetRouter, ResultCache
+from distmlip_tpu.models import PairConfig, PairPotential
+from distmlip_tpu.obs import (FlightRecorder, MetricsRegistry, MetricsServer,
+                              Observability, SLOConfig, SLOMonitor, Tracer,
+                              critical_path_summary, load_trace,
+                              parse_exposition, request_trace_summary,
+                              uninstall)
+from distmlip_tpu.partition import BucketPolicy
+from distmlip_tpu.serve import ServeEngine
+from distmlip_tpu.telemetry import JsonlSink, StepRecord
+
+pytestmark = pytest.mark.obs
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def pair():
+    model = PairPotential(PairConfig(cutoff=4.0))
+    return model, model.init()
+
+
+@pytest.fixture
+def hub():
+    h = Observability.enable()
+    try:
+        yield h
+    finally:
+        uninstall()
+
+
+def make_structure(rng, noise=0.05):
+    unit = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5],
+                     [0, 0.5, 0.5]])
+    frac, lat = geometry.make_supercell(unit, np.eye(3) * 3.6, (2, 2, 2))
+    cart = geometry.frac_to_cart(frac, lat) + rng.normal(
+        0, noise, (len(frac), 3))
+    return Atoms(numbers=np.full(len(cart), 14), positions=cart, cell=lat)
+
+
+def make_engine(pair, **kw):
+    model, params = pair
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_s", 0.005)
+    kw.setdefault("max_queue", 4096)
+    return ServeEngine(BatchedPotential(model, params, caps=BucketPolicy()),
+                       **kw)
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_tracer_nesting_parents_and_new_trace():
+    tr = Tracer()
+    with tr.span("outer") as outer:
+        assert tr.current() == outer.ctx
+        with tr.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        with tr.span("island", new_trace=True) as island:
+            assert island.trace_id != outer.trace_id
+    assert tr.current() is None
+    # explicit parent beats ambient; retroactive emit commits closed
+    s = tr.emit("retro", parent=outer, t_start=1.0, t_end=2.0)
+    assert (s.trace_id, s.parent_id) == (outer.trace_id, outer.span_id)
+    assert s.duration_s == 1.0
+    names = [x.name for x in tr.spans()]
+    assert names == ["inner", "island", "outer", "retro"]  # finish order
+
+
+@pytest.mark.tier1
+def test_tracer_cross_thread_request_handle():
+    tr = Tracer()
+    rt = tr.start_request("engine.submit")
+    seen = {}
+
+    def worker():
+        # no ambient context in this thread: the handle IS the context
+        assert tr.current() is None
+        tr.emit("engine.queue", parent=rt.ctx, t_start=rt.t_submit)
+        seen["ok"] = True
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["ok"]
+    tr.finish_request(rt, "ok")
+    s = request_trace_summary(tr.spans())
+    assert s["requests"] == s["complete"] == 1
+    assert s["terminals"] == 1
+
+
+@pytest.mark.tier1
+def test_tracer_ring_bound_counts_drops():
+    tr = Tracer(max_spans=8)
+    for i in range(20):
+        tr.emit(f"s{i}", new_trace=True)
+    assert len(tr.spans()) == 8
+    assert tr.spans_dropped == 12
+    assert tr.spans_finished == 20
+
+
+@pytest.mark.tier1
+def test_perfetto_roundtrip_preserves_summary(tmp_path):
+    tr = Tracer()
+    rt = tr.start_request("fleet.submit", attrs={"tenant": "a"})
+    with tr.span("serve.batch", new_trace=True, links=[rt.ctx]) as b:
+        t0 = tr.now()
+        tr.emit("batched.pack", parent=b, t_start=t0, t_end=t0 + 0.01)
+        tr.emit("device.dispatch", parent=b, t_start=t0 + 0.01,
+                t_end=t0 + 0.03)
+    tr.emit("engine.queue", parent=rt.ctx, t_start=rt.t_submit)
+    tr.finish_request(rt, "ok")
+    path = tr.write(str(tmp_path / "t.json"))
+    spans = load_trace(path)
+    s = request_trace_summary(spans)
+    assert s["requests"] == s["complete"] == 1
+    # links survive the round trip: batch phases attribute to the request
+    cs = critical_path_summary(spans)
+    assert cs["requests"] == 1
+    assert cs["components"]["pack"]["max"] > 0
+    # the file is a loadable Chrome trace object
+    with open(path) as f:
+        obj = json.load(f)
+    assert any(ev.get("ph") == "X" for ev in obj["traceEvents"])
+
+
+@pytest.mark.tier1
+def test_critical_path_queue_dominant_flag():
+    tr = Tracer(clock=FakeClock())
+    clock = tr._clock
+    for _ in range(4):
+        rt = tr.start_request("engine.submit")
+        clock.advance(1.0)            # 1 s queue wait
+        tr.emit("engine.queue", parent=rt.ctx, t_start=rt.t_submit)
+        with tr.span("serve.batch", new_trace=True, links=[rt.ctx]) as b:
+            t0 = tr.now()
+            clock.advance(0.01)       # 10 ms device
+            tr.emit("device.dispatch", parent=b, t_start=t0)
+        tr.finish_request(rt, "ok")
+    cs = critical_path_summary(tr.spans())
+    assert cs["queue_dominant"]
+    assert cs["components"]["queue"]["p50"] == pytest.approx(1.0, rel=0.01)
+    assert cs["coverage_p50"] > 0.95
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_metrics_registry_counters_gauges_histograms():
+    m = MetricsRegistry()
+    c = m.counter("reqs_total", "requests", labels=("tenant",))
+    c.labels(tenant="a").inc()
+    c.labels(tenant="a").inc(2)
+    c.labels(tenant="b").inc()
+    m.gauge("depth", "queue depth").set(7)
+    h = m.histogram("lat_seconds", "latency")
+    for v in (0.0002, 0.0002, 0.1):
+        h.observe(v)
+    vals = parse_exposition(m.render())
+    assert vals['reqs_total{tenant="a"}'] == 3.0
+    assert vals['reqs_total{tenant="b"}'] == 1.0
+    assert vals["depth"] == 7.0
+    assert vals["lat_seconds_count"] == 3.0
+    assert vals["lat_seconds_sum"] == pytest.approx(0.1004)
+    # log-bucket quantile: upper bound of the bucket the rank falls in
+    assert h.quantile(0.5) == pytest.approx(0.0002)
+    assert h.quantile(0.99) >= 0.1
+    # snapshot is JSON-dumpable (the bench artifact path)
+    json.dumps(m.snapshot())
+    # re-registration: same kind returns the family, new kind raises
+    assert m.counter("reqs_total", labels=("tenant",)) is c
+    with pytest.raises(ValueError):
+        m.gauge("reqs_total")
+
+
+@pytest.mark.tier1
+def test_metrics_server_scrapes():
+    m = MetricsRegistry()
+    m.counter("up_total", "x").inc(5)
+    with MetricsServer(m, port=0) as srv:
+        assert srv.port > 0
+        body = urllib.request.urlopen(srv.url, timeout=10).read().decode()
+    assert parse_exposition(body)["up_total"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor + flight recorder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_slo_burn_rate_breach_fires_once_per_cooldown():
+    clock = FakeClock()
+    fired = []
+    mon = SLOMonitor(default=SLOConfig(
+        latency_s=0.1, objective=0.9, fast_window_s=10.0,
+        slow_window_s=60.0, fast_burn=5.0, slow_burn=3.0,
+        min_requests=8, cooldown_s=30.0), clock=clock,
+        on_breach=lambda t, info: fired.append(info))
+    # healthy traffic: no breach
+    for _ in range(20):
+        clock.advance(0.5)
+        mon.observe("a", 0.01)
+    assert not fired
+    # sustained badness: exactly ONE firing inside the cooldown window
+    for _ in range(20):
+        clock.advance(0.5)
+        mon.observe("a", 1.0)
+    assert len(fired) == 1
+    assert fired[0]["tenant"] == "a"
+    assert fired[0]["fast_burn"] >= 5.0
+    clock.advance(31.0)              # past cooldown: it may fire again
+    for _ in range(10):
+        clock.advance(0.2)
+        mon.observe("a", 1.0)
+    assert len(fired) == 2
+    snap = mon.snapshot()
+    assert snap["a"]["breaches"] == 2 and snap["a"]["bad"] == 30
+
+
+@pytest.mark.tier1
+def test_slo_min_requests_guards_tiny_samples():
+    clock = FakeClock()
+    fired = []
+    mon = SLOMonitor(default=SLOConfig(
+        latency_s=0.1, min_requests=50, fast_window_s=10,
+        slow_window_s=60), clock=clock,
+        on_breach=lambda t, info: fired.append(info))
+    for _ in range(20):
+        clock.advance(0.1)
+        mon.observe("a", 9.9)
+    assert not fired                 # 100% bad, but n < min_requests
+
+
+@pytest.mark.tier1
+def test_flight_recorder_capture_and_rate_limit(tmp_path):
+    clock = FakeClock()
+    tr = Tracer()
+    rt = tr.start_request("engine.submit")
+    tr.finish_request(rt, "ok")
+    m = MetricsRegistry()
+    m.counter("c_total", "x").inc()
+    fr = FlightRecorder(str(tmp_path), tracer=tr, metrics=m,
+                        min_interval_s=10.0, clock=clock)
+    d = fr.capture("test", attrs={"k": 1})
+    assert d is not None and os.path.isdir(d)
+    names = sorted(os.listdir(d))
+    assert names == ["incident.json", "metrics.json", "metrics.prom",
+                     "trace.json"]
+    with open(os.path.join(d, "incident.json")) as f:
+        meta = json.load(f)
+    assert meta["reason"] == "test" and meta["attrs"] == {"k": 1}
+    # the captured trace is loadable and complete
+    s = request_trace_summary(load_trace(os.path.join(d, "trace.json")))
+    assert s["complete"] == 1
+    assert "c_total 1" in open(os.path.join(d, "metrics.prom")).read()
+    # rate limit: suppressed inside the interval, allowed after
+    assert fr.capture("again") is None
+    assert fr.suppressed == 1
+    clock.advance(11.0)
+    assert fr.capture("later") is not None
+    assert fr.snapshot()["captures"] == 2
+
+
+def test_slo_breach_autocaptures_through_hub(tmp_path):
+    clock = FakeClock()
+    h = Observability.enable(
+        slo=SLOConfig(latency_s=0.1, min_requests=4, fast_window_s=10,
+                      slow_window_s=60, fast_burn=2.0, slow_burn=2.0),
+        flight_dir=str(tmp_path), min_interval_s=0.0, clock=clock,
+        register=False)
+    for _ in range(10):
+        clock.advance(0.2)
+        h.slo.observe("t", 5.0)
+    assert h.flight.captures >= 1
+    inc = h.flight.incidents[0]
+    meta = json.load(open(os.path.join(inc, "incident.json")))
+    assert "burn-rate breach" in meta["reason"]
+
+
+# ---------------------------------------------------------------------------
+# JsonlSink rotation (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_jsonl_sink_rotation_bounds_and_preserves_records(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    sink = JsonlSink(path, max_bytes=2048, keep=2)
+    n = 100
+    for i in range(n):
+        sink.emit(StepRecord(step=i, kind="t"))
+    stats = sink.stats()
+    sink.close()
+    assert stats["rotations"] >= 2
+    assert stats["lines"] == n
+    # at most keep rotated files + the live one, each bounded
+    rotated = sink.rotated_paths()
+    assert 1 <= len(rotated) <= 2
+    for p in (path, *rotated):
+        assert os.path.getsize(p) <= 2048 + 512   # one record of slack
+    # rotation never loses or splits a record: every surviving line
+    # parses, steps are contiguous across the file seams (newest last),
+    # and the newest surviving record is the last one emitted
+    from distmlip_tpu.telemetry.report import read_jsonl
+
+    steps = []
+    for p in (*reversed(rotated), path):   # oldest -> newest
+        steps.extend(r.step for r in read_jsonl(p))
+    assert steps
+    assert steps == list(range(steps[0], n))   # contiguous, none split
+    assert steps[-1] == n - 1
+
+
+@pytest.mark.tier1
+def test_jsonl_sink_no_rotation_by_default(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    sink = JsonlSink(path)
+    for i in range(50):
+        sink.emit(StepRecord(step=i))
+    sink.close()
+    assert sink.stats()["rotations"] == 0
+    assert sink.rotated_paths() == []
+    with pytest.raises(ValueError):
+        JsonlSink(str(tmp_path / "x.jsonl"), max_bytes=0)
+
+
+# ---------------------------------------------------------------------------
+# DML003 lint (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+@pytest.mark.contracts
+def test_lint_dml003_flags_span_in_jit(tmp_path):
+    from distmlip_tpu.analysis.lint import lint_file
+
+    src = '''
+import jax
+from distmlip_tpu.obs import runtime as obsrt
+
+@jax.jit
+def bad_step(x):
+    tr = obsrt.tracer()
+    with tr.span("device.math"):      # DML003: host span in jit
+        return x * 2
+
+def good_host(x):
+    tr = obsrt.tracer()
+    with tr.span("host.phase"):       # host fn: fine
+        return x * 2
+
+def energy_fn(params, lg, positions):
+    from distmlip_tpu.telemetry import scope
+    with scope("model/forward"):      # named_scope is exempt
+        return positions.sum()
+'''
+    p = tmp_path / "seeded.py"
+    p.write_text(src)
+    findings = [f for f in lint_file(str(p)) if not f.suppressed]
+    dml3 = [f for f in findings if f.rule == "DML003"]
+    assert len(dml3) == 1
+    assert dml3[0].location[1] == src.splitlines().index(
+        '    with tr.span("device.math"):      # DML003: host span in jit'
+    ) + 1
+    # suppression comment works like every other rule
+    src2 = src.replace(
+        'with tr.span("device.math"):      # DML003: host span in jit',
+        'with tr.span("device.math"):  # contract: allow(DML003)')
+    p2 = tmp_path / "suppressed.py"
+    p2.write_text(src2)
+    assert not [f for f in lint_file(str(p2))
+                if f.rule == "DML003" and not f.suppressed]
+
+
+@pytest.mark.tier1
+@pytest.mark.contracts
+def test_lint_dml003_clean_on_repo():
+    """The shipped instrumentation never creates spans in device code."""
+    from distmlip_tpu.analysis.lint import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = lint_paths([os.path.join(repo, "distmlip_tpu")],
+                          package_root=repo)
+    assert not [f for f in findings
+                if f.rule == "DML003" and not f.suppressed]
+
+
+# ---------------------------------------------------------------------------
+# engine + fleet integration: span conservation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_engine_traces_complete_and_records_stamped(rng, pair, hub):
+    from distmlip_tpu.telemetry import Telemetry
+
+    class _ListSink:
+        def __init__(self):
+            self.records = []
+
+        def emit(self, r):
+            self.records.append(r)
+
+        def close(self):
+            pass
+
+    sink = _ListSink()
+    engine = make_engine(pair, telemetry=Telemetry([sink]))
+    futs = [engine.submit(make_structure(rng)) for _ in range(10)]
+    for f in futs:
+        assert "energy" in f.result(timeout=120)
+    engine.drain(timeout=60)
+    engine.close()
+    spans = hub.tracer.spans()
+    s = request_trace_summary(spans)
+    assert s["requests"] == s["complete"] == 10
+    assert s["terminals"] == 10
+    # batch spans link member requests and phases attribute to them
+    cs = critical_path_summary(spans)
+    assert cs["coverage_p50"] >= 0.9
+    # serve_batch records carry the batch span ids; batched_calculate
+    # records stamp the ambient context — both correlate with the trace
+    batch_recs = [r for r in sink.records if r.kind == "serve_batch"]
+    assert batch_recs and all(r.trace_id for r in batch_recs)
+    trace_ids = {sp.trace_id for sp in spans}
+    assert all(r.trace_id in trace_ids for r in batch_recs)
+    pot_recs = [r for r in sink.records if r.kind == "batched_calculate"]
+    assert pot_recs and all(r.trace_id for r in pot_recs)
+    # live metrics populated from the same instrumentation points
+    vals = parse_exposition(hub.metrics.render())
+    assert vals["distmlip_serve_submitted_total"] == 10.0
+    assert vals["distmlip_serve_completed_total"] == 10.0
+
+
+@pytest.mark.tier1
+def test_engine_error_paths_close_traces(rng, pair, hub):
+    engine = make_engine(pair)
+    good = engine.submit(make_structure(rng))
+    bad_atoms = make_structure(rng)
+    bad_atoms.positions = bad_atoms.positions.copy()
+    bad_atoms.positions[0, 0] = np.nan
+    bad = engine.submit(bad_atoms)
+    assert "energy" in good.result(timeout=120)
+    with pytest.raises(Exception):
+        bad.result(timeout=120)
+    engine.close()
+    s = request_trace_summary(hub.tracer.spans())
+    # the poison request still leaves a complete tree (terminal: error)
+    assert s["requests"] == s["complete"] == 2
+    assert s["terminals"] == 2
+
+
+def test_failover_trace_propagation_kill_replica_mid_burst(rng, pair, hub):
+    """The satellite contract: kill_replica() mid-burst must leave every
+    reclaimed request with a complete span tree ending in exactly one
+    future.resolve — no orphan or duplicate terminal spans — and
+    span-count conservation must hold across the cache-hit and coalesce
+    short-circuits in the same run."""
+    router = FleetRouter([make_engine(pair) for _ in range(2)],
+                         result_cache=ResultCache(), model_id="pair")
+    structs = [make_structure(rng) for _ in range(30)]
+    futs = [router.submit(a) for a in structs[:15]]
+    moved = router.kill_replica("r0")
+    futs += [router.submit(a) for a in structs[15:]]
+    for f in futs:
+        assert "energy" in f.result(timeout=120)
+    router.drain(timeout=60)
+    # cache hits + a coalesce race: each submission still owns a tree
+    dup_futs = [router.submit(structs[0]) for _ in range(3)]
+    fresh = make_structure(rng)
+    co1, co2 = router.submit(fresh), router.submit(fresh)
+    for f in (*dup_futs, co1, co2):
+        assert "energy" in f.result(timeout=120)
+    router.drain(timeout=60)
+    router.close()
+    assert moved >= 1
+    assert router.stats.failovers == 1 and router.stats.failed == 0
+    n_submitted = len(futs) + len(dup_futs) + 2
+    s = request_trace_summary(hub.tracer.spans())
+    assert s["requests"] == n_submitted
+    assert s["complete"] == n_submitted          # every tree closed
+    assert s["terminals"] == n_submitted         # exactly one each
+    assert s["terminal_violation_count"] == 0    # no orphan/duplicate
+    assert hub.tracer.spans_dropped == 0
+    # re-dispatched requests carry their failover history as spans
+    requeues = [sp for sp in hub.tracer.spans()
+                if sp.name == "router.requeue"]
+    assert len(requeues) >= moved
+    # and the critical path still explains the measured latency
+    cs = critical_path_summary(hub.tracer.spans())
+    assert cs["coverage_p50"] >= 0.9
+    # failover metrics moved with it
+    vals = parse_exposition(hub.metrics.render())
+    assert vals["distmlip_fleet_failovers_total"] == 1.0
+    assert vals['distmlip_replica_alive{replica="r0"}'] == 0.0
+    assert vals['distmlip_replica_alive{replica="r1"}'] == 1.0
+
+
+def test_report_trace_dir_renders_critical_path(tmp_path, rng, pair, hub,
+                                                capsys):
+    """telemetry_report --trace-dir: per-request percentiles next to the
+    per-phase table, queue_dominant flagged as an anomaly (exit 4)."""
+    from distmlip_tpu.telemetry import JsonlSink, Telemetry
+    from distmlip_tpu.telemetry.report import main as report_main
+
+    jsonl = str(tmp_path / "run.jsonl")
+    tel = Telemetry([JsonlSink(jsonl)])
+    # force queue dominance: a tiny max_batch + burst of submissions
+    engine = make_engine(pair, max_batch=1, max_wait_s=0.0,
+                         telemetry=tel)
+    futs = [engine.submit(make_structure(rng)) for _ in range(8)]
+    for f in futs:
+        f.result(timeout=120)
+    engine.close()
+    tel.close()
+    tdir = tmp_path / "traces"
+    tdir.mkdir()
+    hub.tracer.write(str(tdir / "burst.json"))
+    rc = report_main([jsonl, "--trace-dir", str(tdir)])
+    out = capsys.readouterr().out
+    assert "trace critical path (8 request(s)):" in out
+    assert "queue" in out and "device" in out.lower()
+    if "queue_dominant=True" in out:
+        assert rc == 4
+        assert "[queue_dominant]" in out
+    else:                             # machine too fast to queue: still ok
+        assert rc in (0, 4)
+
+
+def test_load_test_cli_metrics_and_trace_gates(tmp_path):
+    """tools/load_test.py --fleet --check with --metrics-port and
+    --trace-out: the trace_complete + metrics_scrape gates hold and the
+    exported trace is a valid Perfetto artifact."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace_out = str(tmp_path / "trace.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "load_test.py"),
+         "--fleet", "2", "--requests", "16", "--check",
+         "--metrics-port", "0", "--trace-out", trace_out],
+        cwd=repo, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["check"] == "ok"
+    assert summary["checks"]["trace_complete"]
+    assert summary["checks"]["trace_critical_path"]
+    assert summary["checks"]["metrics_scrape"]
+    assert summary["trace"]["terminal_violations"] == 0
+    spans = load_trace(trace_out)
+    s = request_trace_summary(spans)
+    assert s["requests"] == summary["trace"]["request_traces"]
+    assert s["complete"] == s["requests"]

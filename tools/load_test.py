@@ -90,6 +90,80 @@ def make_pool(rng, n_structures: int, species: int = 14):
     return pool
 
 
+def setup_obs(args):
+    """Enable the observability hub (+ optional metrics endpoint) when
+    the run asks for it. ``--obs auto`` lights up with ``--check`` /
+    ``--trace-out`` / ``--metrics-port`` so the acceptance gates always
+    measure the instrumented configuration; ``--obs off`` forces the
+    uninstrumented baseline (the overhead A/B lever)."""
+    want = (args.obs == "on"
+            or (args.obs == "auto"
+                and (args.check or args.trace_out
+                     or args.metrics_port is not None)))
+    if not want:
+        return None, None
+    from distmlip_tpu.obs import MetricsServer, Observability
+
+    hub = Observability.enable()
+    server = (MetricsServer(hub.metrics, port=args.metrics_port)
+              if args.metrics_port is not None else None)
+    return hub, server
+
+
+def scrape_metrics(server, expected: dict) -> tuple[bool, dict]:
+    """One GET /metrics; compare the scraped sample lines against the
+    loadgen's own totals (the --metrics-port smoke)."""
+    import urllib.request
+
+    from distmlip_tpu.obs import parse_exposition
+
+    body = urllib.request.urlopen(server.url, timeout=10).read().decode()
+    vals = parse_exposition(body)
+    scraped = {k: vals.get(k, 0.0) for k in expected}
+    ok = all(scraped[k] == v for k, v in expected.items())
+    return ok, scraped
+
+
+def trace_summary_block(hub, n_submitted: int, trace_out=None) -> dict:
+    """Span-tree conservation + critical-path coverage over the run."""
+    from distmlip_tpu.obs.export import (critical_path_summary,
+                                         request_trace_summary)
+
+    spans = hub.tracer.spans()
+    tsum = request_trace_summary(spans)
+    csum = critical_path_summary(spans)
+    out = {
+        "submitted": n_submitted,
+        "request_traces": tsum["requests"],
+        "complete": tsum["complete"],
+        "terminals": tsum["terminals"],
+        "terminal_violations": tsum["terminal_violation_count"],
+        "spans_dropped": hub.tracer.spans_dropped,
+        "coverage_p50": round(csum.get("coverage_p50", 0.0), 3),
+        "queue_dominant": bool(csum.get("queue_dominant", False)),
+    }
+    if trace_out:
+        hub.tracer.write(trace_out)
+        out["path"] = trace_out
+    return out
+
+
+def trace_checks(trace: dict) -> dict:
+    """The trace_complete + critical-path acceptance gates: every
+    submitted request left a CLOSED span tree with exactly one
+    future.resolve terminal (span-count conservation across the
+    cache-hit/coalesce/failover paths), and the per-request span
+    coverage explains >= 90% of the measured request latency."""
+    return {
+        "trace_complete": (
+            trace["request_traces"] == trace["submitted"]
+            and trace["complete"] == trace["submitted"]
+            and trace["terminal_violations"] == 0
+            and trace["spans_dropped"] == 0),
+        "trace_critical_path": trace["coverage_p50"] >= 0.9,
+    }
+
+
 def build_model(name: str):
     import jax
 
@@ -119,9 +193,11 @@ def run(args) -> int:
     model, params = build_model(args.model)
     pool = make_pool(rng, max(8, args.requests // 4))
     caps = BucketPolicy()
+    hub, metrics_server = setup_obs(args)
     telemetry = None
     if args.jsonl:
-        telemetry = Telemetry([JsonlSink(args.jsonl)])
+        telemetry = Telemetry([JsonlSink(args.jsonl,
+                                         max_bytes=args.jsonl_max_bytes)])
     budget_bytes = (int(args.hbm_budget_gb * 2**30)
                     if args.hbm_budget_gb else None)
     pot = BatchedPotential(model, params, caps=caps, skin=args.skin,
@@ -178,6 +254,14 @@ def run(args) -> int:
     engine.close()
     close_s = time.perf_counter() - t0
 
+    scraped_ok = scraped = None
+    if metrics_server is not None:
+        scraped_ok, scraped = scrape_metrics(metrics_server, {
+            "distmlip_serve_submitted_total": float(stats["submitted"]),
+            "distmlip_serve_completed_total": float(stats["completed"]),
+        })
+        metrics_server.close()
+
     summary = {
         "metric": "serve_load_test",
         "requests": sum(r.n_requests for r in reports.values()),
@@ -199,6 +283,11 @@ def run(args) -> int:
     if telemetry is not None:
         telemetry.close()
         summary["jsonl"] = args.jsonl
+    if hub is not None:
+        summary["trace"] = trace_summary_block(
+            hub, stats["submitted"], trace_out=args.trace_out)
+    if scraped is not None:
+        summary["metrics_scrape"] = scraped
 
     contract_errors = None
     est_peak = None
@@ -259,6 +348,10 @@ def run(args) -> int:
             # (no budget known -> the pass only reports, never errors)
             checks["contracts"] = contract_errors == 0
             checks["memory_planned"] = bool(est_peak and est_peak > 0)
+        if hub is not None:
+            checks.update(trace_checks(summary["trace"]))
+        if scraped_ok is not None:
+            checks["metrics_scrape"] = scraped_ok
         summary["checks"] = checks
         summary["compile_bound"] = bound
         if not all(checks.values()):
@@ -295,9 +388,11 @@ def run_fleet(args) -> int:
 
     rng = np.random.default_rng(args.seed)
     model, params = build_model(args.model)
+    hub, metrics_server = setup_obs(args)
     telemetry = None
     if args.jsonl:
-        telemetry = Telemetry([JsonlSink(args.jsonl)])
+        telemetry = Telemetry([JsonlSink(args.jsonl,
+                                         max_bytes=args.jsonl_max_bytes)])
     policies = [BucketPolicy() for _ in range(args.fleet)]
     engines = [
         ServeEngine(
@@ -340,7 +435,19 @@ def run_fleet(args) -> int:
             trigger=FineTuneTrigger(TriggerPolicy(min_buffer=1 << 30)),
             telemetry=telemetry, seed=args.seed)
 
+    # per-tenant submission ledger (the --metrics-port smoke compares the
+    # scraped tenant counters against these) + total submissions (the
+    # trace_complete gate compares span trees against this)
+    tenant_totals: dict = {}
+    n_submitted = 0
+
+    def count_submit(tenant="default"):
+        nonlocal n_submitted
+        n_submitted += 1
+        tenant_totals[tenant] = tenant_totals.get(tenant, 0) + 1
+
     def fleet_submit(atoms, **kw):
+        count_submit(kw.get("tenant", "default"))
         return loop.submit(atoms, **kw) if loop is not None \
             else router.submit(atoms, **kw)
 
@@ -383,6 +490,7 @@ def run_fleet(args) -> int:
     dup_futs = []
     dup_ok = 0
     for i in range(n_dup):
+        count_submit()
         dup_futs.append(router.submit(uniques[i % n_uniq]))
     for f in dup_futs:
         try:
@@ -433,7 +541,13 @@ def run_fleet(args) -> int:
             if not rep.alive:
                 continue
             for b in b_sizes:
-                warm = [rep.engine.submit(a) for a in swap_pool[:b]]
+                # direct engine submissions: each still opens its own
+                # (engine-rooted) request trace, so they count toward
+                # the span-conservation gate like everything else
+                warm = []
+                for a in swap_pool[:b]:
+                    n_submitted += 1
+                    warm.append(rep.engine.submit(a))
                 rep.engine.drain(timeout=120)
                 for f in warm:
                     f.result(timeout=300)
@@ -453,6 +567,7 @@ def run_fleet(args) -> int:
             if i == max(n_uniq // 4, 1) and swap_report is None:
                 # mid-burst: earlier submissions are queued/in flight
                 swap_report = loop.swap_now(new_params)
+            count_submit()
             swap_futs.append(loop.submit(a))
         if swap_report is None:          # tiny bursts: swap after the loop
             swap_report = loop.swap_now(new_params)
@@ -478,6 +593,13 @@ def run_fleet(args) -> int:
     router.close()
     if telemetry is not None:
         telemetry.close()
+    scraped_ok = scraped = None
+    if metrics_server is not None:
+        expected = {
+            f'distmlip_fleet_requests_total{{tenant="{t}"}}': float(n)
+            for t, n in sorted(tenant_totals.items())}
+        scraped_ok, scraped = scrape_metrics(metrics_server, expected)
+        metrics_server.close()
 
     n_atoms = [len(a) for a in uniques]
     bound = args.fleet * policies[0].ladder_bound(
@@ -515,6 +637,11 @@ def run_fleet(args) -> int:
         }
     if args.jsonl:
         summary["jsonl"] = args.jsonl
+    if hub is not None:
+        summary["trace"] = trace_summary_block(
+            hub, n_submitted, trace_out=args.trace_out)
+    if scraped is not None:
+        summary["metrics_scrape"] = scraped
     rc = 0
     if args.check:
         checks = {
@@ -542,6 +669,10 @@ def run_fleet(args) -> int:
             checks["active_model_id_rolled"] = router.model_id != args.model
             checks["active_escalations_evaluated"] = \
                 loop.stats.evaluated > 0
+        if hub is not None:
+            checks.update(trace_checks(summary["trace"]))
+        if scraped_ok is not None:
+            checks["metrics_scrape"] = scraped_ok
         summary["checks"] = checks
         if not all(checks.values()):
             summary["check"] = "FAIL"
@@ -572,6 +703,23 @@ def main(argv=None) -> int:
                    help="inject N NaN-position requests (isolation probe)")
     p.add_argument("--jsonl", default=None,
                    help="write telemetry StepRecords here")
+    p.add_argument("--jsonl-max-bytes", type=int, default=None,
+                   help="rotate the telemetry JSONL past this size "
+                        "(JsonlSink max_bytes; keeps 3 rotated files)")
+    p.add_argument("--obs", choices=("auto", "on", "off"), default="auto",
+                   help="observability hub (distmlip_tpu.obs): tracing + "
+                        "metrics. auto = on whenever --check/--trace-out/"
+                        "--metrics-port ask for it; off = uninstrumented "
+                        "baseline for the overhead A/B")
+    p.add_argument("--trace-out", default=None,
+                   help="write the run's Perfetto trace_event JSON here "
+                        "(view at ui.perfetto.dev or via "
+                        "tools/trace_view.py)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus exposition on this port for "
+                        "the run (0 = ephemeral) and scrape it once at "
+                        "the end; with --check, the scraped tenant "
+                        "counters must match the loadgen totals")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--check", action="store_true",
                    help="assert acceptance criteria; exit 3 on failure")
@@ -614,9 +762,14 @@ def main(argv=None) -> int:
         print("usage error: --active requires fleet mode (--fleet N)",
               file=sys.stderr)
         return 2
-    if args.fleet > 0:
-        return run_fleet(args)
-    return run(args)
+    try:
+        if args.fleet > 0:
+            return run_fleet(args)
+        return run(args)
+    finally:
+        from distmlip_tpu.obs import uninstall
+
+        uninstall()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Render a per-request critical-path breakdown from an exported trace.
+
+    python tools/trace_view.py trace.json [--top N] [--json out.json]
+
+Reads the Perfetto/Chrome ``trace_event`` JSON written by
+``distmlip_tpu.obs`` (``Tracer.write``, ``load_test --trace-out``, or a
+flight-recorder incident's ``trace.json``; a directory of such files
+also works) and answers "where did request X spend its time": the
+per-component percentile table (queue wait vs pack vs compile vs device
+vs resolve), the span-coverage measure (what fraction of each request's
+wall time the spans explain), the ``queue_dominant`` verdict, and the
+``--top N`` slowest requests with their individual breakdowns.
+
+The same file loads directly in ``ui.perfetto.dev`` for the visual
+timeline; this tool is the terminal-side summary.
+
+Exit codes: 0 ok, 1 unreadable input, 2 usage.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distmlip_tpu.obs.export import (COMPONENTS, critical_path_summary,  # noqa: E402
+                                     critical_paths, format_critical_path,
+                                     load_trace_dir,
+                                     request_trace_summary)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace", help="trace JSON file (or directory of them)")
+    p.add_argument("--top", type=int, default=5,
+                   help="show the N slowest requests' breakdowns")
+    p.add_argument("--json", default=None,
+                   help="also dump the summary + per-request paths here")
+    args = p.parse_args(argv)
+    try:
+        spans = load_trace_dir(args.trace)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.trace}: {e}", file=sys.stderr)
+        return 1
+    completeness = request_trace_summary(spans)
+    summary = critical_path_summary(spans)
+    paths = critical_paths(spans)
+
+    print(f"spans={len(spans)} request_traces={completeness['requests']} "
+          f"complete={completeness['complete']} "
+          f"terminal_violations="
+          f"{completeness['terminal_violation_count']}")
+    print()
+    print(format_critical_path(summary))
+    if paths and args.top > 0:
+        paths.sort(key=lambda p: p["total_s"], reverse=True)
+        print()
+        print(f"slowest {min(args.top, len(paths))} request(s):")
+        hdr = "  trace_id".ljust(26) + "total_ms".rjust(9)
+        for comp in COMPONENTS:
+            if summary["components"].get(comp, {}).get("max", 0) > 0:
+                hdr += f"{comp:>9}"
+        hdr += "  cover"
+        print(hdr)
+        for path in paths[:args.top]:
+            row = f"  {path['trace_id']:<24}{1e3 * path['total_s']:9.2f}"
+            for comp in COMPONENTS:
+                if summary["components"].get(comp, {}).get("max", 0) > 0:
+                    row += f"{1e3 * path[comp]:9.2f}"
+            row += f"{path['coverage']:7.2f}"
+            print(row)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"completeness": completeness, "summary": summary,
+                       "requests": paths}, f, indent=2, sort_keys=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

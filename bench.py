@@ -555,6 +555,99 @@ def _main_measured():
         except Exception as e:  # noqa: BLE001 - train phase is additive
             train_extras["train_error"] = f"{type(e).__name__}: {e}"[:160]
 
+    # cost-model packing A/B: naive single-cap vs tiered edge-balanced
+    # packing on a synthetic LONG-TAIL dataset (lognormal structure
+    # sizes) — examples/sec, measured padding_waste_frac and per-tier
+    # compile counts land in the round artifact so the BENCH trajectory
+    # captures the data-distribution win (CPU dryrun populates the same
+    # fields). Small TensorNet: the A/B is data-distribution-bound, not
+    # model-bound. BENCH_TRAIN=0 or BENCH_TRAIN_PACKING=0 skips.
+    if (os.environ.get("BENCH_TRAIN", "1") != "0"
+            and os.environ.get("BENCH_TRAIN_PACKING", "1") != "0"):
+        p_budget = float(os.environ.get("BENCH_TRAIN_PACKING_TIMEOUT_S",
+                                        "600"))
+        watchdog.phase(
+            f"train packing A/B exceeded {p_budget:.0f}s", p_budget)
+        try:
+            import optax
+
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            from pack_audit import synth_longtail_samples
+
+            from distmlip_tpu.models.tensornet import (TensorNet,
+                                                       TensorNetConfig)
+            from distmlip_tpu.train import Trainer, structure_needs
+
+            n_lt = int(os.environ.get("BENCH_TRAIN_PACKING_STRUCTURES",
+                                      "200"))
+            lt_steps = int(os.environ.get("BENCH_TRAIN_PACKING_STEPS", "6"))
+            b_lt = int(os.environ.get("BENCH_TRAIN_PACKING_BATCH", "8"))
+            lt_cut = 3.5
+            tiny = TensorNet(TensorNetConfig(
+                num_species=4, units=16, num_rbf=6, num_layers=2,
+                cutoff=lt_cut))
+            p_lt = tiny.init(jax.random.PRNGKey(2))
+            samples_lt = synth_longtail_samples(
+                n_lt, seed=5, mu=3.0, sigma=1.0, min_atoms=4,
+                max_atoms=600)
+            needs_lt = structure_needs([s.atoms for s in samples_lt],
+                                       lt_cut)
+            packing = {}
+            for mode, extra_kw in (("naive", {}),
+                                   ("cost_model",
+                                    {"packing": "cost_model",
+                                     "num_tiers": 3})):
+                tr = Trainer(
+                    tiny.energy_fn, p_lt, optax.adam(1e-3), samples_lt,
+                    lt_cut, micro_batch_size=b_lt, hbm_budget_frac=0.95,
+                    loader_kwargs={
+                        "seed": 1, "precomputed_needs": needs_lt,
+                        "species_fn":
+                            lambda z: np.zeros(len(z), np.int32),
+                        **extra_kw})
+                # warm until EVERY tier's first step has run — the
+                # measured window must see zero compiles
+                tr.fit(steps=max(
+                    tr.loader.tier_first_steps().values()) + 1)
+                t0 = time.perf_counter()
+                hist = tr.fit(steps=lt_steps)[-lt_steps:]
+                dt_p = (time.perf_counter() - t0) / max(lt_steps, 1)
+                tier_steps = {}
+                for h in hist:
+                    tier_steps[h["tier"]] = tier_steps.get(
+                        h["tier"], 0) + 1
+                packing[mode] = {
+                    "examples_per_sec": round(b_lt / dt_p, 2),
+                    "padding_waste_frac": round(float(np.mean(
+                        [h["padding_waste_frac"] for h in hist])), 4),
+                    "edge_balance": round(float(min(
+                        h["edge_balance"] for h in hist)), 4),
+                    "compiles": tr.compile_count,
+                    "tiers": tr.loader.num_tiers,
+                    "tier_steps": {str(k): v
+                                   for k, v in sorted(tier_steps.items())},
+                    "tier_est_peak_mib": {
+                        str(k): round(v / 2**20, 1)
+                        for k, v in sorted(tr.tier_peak_bytes.items())},
+                }
+                tr.close()
+            train_extras["train_packing"] = packing
+            w_n = packing["naive"]["padding_waste_frac"]
+            w_c = packing["cost_model"]["padding_waste_frac"]
+            train_extras["train_padding_waste_naive"] = w_n
+            train_extras["train_padding_waste_cost_model"] = w_c
+            if w_c > 0:
+                train_extras["train_packing_waste_ratio"] = round(
+                    w_n / w_c, 2)
+            train_extras["train_examples_per_sec_naive"] = \
+                packing["naive"]["examples_per_sec"]
+            train_extras["train_examples_per_sec_cost_model"] = \
+                packing["cost_model"]["examples_per_sec"]
+        except Exception as e:  # noqa: BLE001 - packing A/B is additive
+            train_extras["train_packing_error"] = \
+                f"{type(e).__name__}: {e}"[:160]
+
     # device-resident MD: steps/sec through DeviceMD with the neighbor
     # rebuild ON DEVICE (in-loop cell list, zero host syncs) vs the host
     # FPIS rebuild at EQUAL skin, plus a rebuilds/sec microbench of the

@@ -7,7 +7,8 @@ from .capacity import (BucketPolicy, CapacityPolicy, FixedCaps,
                        round_capacity)
 from .batch import (MeshPackedHostData, PackedHostData, bucket_key,
                     build_packed_refresh_spec, device_refresh_packed,
-                    pack_structures, pack_structures_mesh, packed_stats)
+                    graph_live_slots, pack_structures, pack_structures_mesh,
+                    packed_stats, slot_waste_frac)
 
 __all__ = [
     "PartitionPlan",
@@ -31,6 +32,8 @@ __all__ = [
     "pack_structures",
     "pack_structures_mesh",
     "packed_stats",
+    "graph_live_slots",
+    "slot_waste_frac",
     "bucket_key",
     "build_packed_refresh_spec",
     "device_refresh_packed",

@@ -909,6 +909,31 @@ def device_refresh_packed(static, arrays, graph, positions):
                                   positions)
 
 
+def slot_waste_frac(live: int, slots: int) -> float:
+    """THE padding-waste definition: dead padded slots / total slots over
+    the compute-bearing arrays. Single source of truth — the serving pack
+    stats (:func:`packed_stats`), the training loader's per-step numbers
+    (train/data.py) and the analytic predictions (train/packing.py,
+    tools/pack_audit.py) all compute waste through this one function, so
+    a report can never show two definitions of the same metric."""
+    return 1.0 - live / slots if slots else 0.0
+
+
+def graph_live_slots(graph: PartitionedGraph) -> tuple:
+    """(live, slots) census of a packed graph's compute-bearing rows —
+    node, edge and (when present) line-graph slots across all partitions.
+    ``slot_waste_frac(*graph_live_slots(g))`` is the pack's
+    ``padding_waste_frac``."""
+    P = graph.num_partitions
+    live = int(np.asarray(graph.node_mask).sum()) \
+        + int(np.asarray(graph.edge_mask).sum())
+    slots = P * (graph.n_cap + graph.e_cap)
+    if graph.has_bond_graph:
+        slots += P * int(graph.line_src.shape[-1])
+        live += int(np.asarray(graph.line_mask).sum())
+    return live, slots
+
+
 def packed_stats(graph: PartitionedGraph, n_real_structures: int) -> dict:
     """Telemetry stats for a packed batch (host numpy, before device_put).
 
@@ -922,13 +947,7 @@ def packed_stats(graph: PartitionedGraph, n_real_structures: int) -> dict:
     P = graph.num_partitions
     nodes = np.asarray(graph.node_mask).sum(axis=1)
     edges = np.asarray(graph.edge_mask).sum(axis=1)
-    n_real = int(nodes.sum())
-    e_real = int(edges.sum())
-    slots = P * (graph.n_cap + graph.e_cap)
-    live = n_real + e_real
-    if graph.has_bond_graph:
-        slots += P * int(graph.line_src.shape[-1])
-        live += int(np.asarray(graph.line_mask).sum())
+    live, slots = graph_live_slots(graph)
     # total structure slots across batch shards (the legacy pack has one)
     total_slots = graph.batch_parts * graph.batch_size
     stats = {
@@ -950,7 +969,7 @@ def packed_stats(graph: PartitionedGraph, n_real_structures: int) -> dict:
         "batch_occupancy": (n_real_structures / total_slots
                             if total_slots else 0.0),
         "bucket_key": bucket_key(graph),
-        "padding_waste_frac": 1.0 - live / slots if slots else 0.0,
+        "padding_waste_frac": slot_waste_frac(live, slots),
         "spatial_parts": graph.spatial_size,
         "batch_parts": graph.batch_parts,
         "mesh_shape": [graph.batch_parts, graph.spatial_size],

@@ -88,6 +88,19 @@ class FixedCaps:
                 f"precomputed cross-shard maximum was wrong")
         return cap
 
+    def as_dict(self) -> dict[str, int]:
+        """The precomputed capacities (a copy) — the analytic planners
+        (train/packing.py, tools/pack_audit.py) price tiers and predict
+        waste from these without building a graph."""
+        return dict(self._caps)
+
+    def fingerprint(self) -> str:
+        """Stable id of the FROZEN capacity set: two equal fingerprints
+        pack onto byte-identical static shapes (the per-tier analogue of
+        ``BucketPolicy.fingerprint``)."""
+        return "fixed:" + ",".join(
+            f"{k}={v}" for k, v in sorted(self._caps.items()))
+
 
 def fixed_caps_for_batches(per_structure_needs, batch_size: int,
                            policy=None) -> FixedCaps:

@@ -236,6 +236,13 @@ class TrainRecord(StepRecord):
     micro_batch_size: int = 0        # structures per micro-batch
     examples_per_sec: float = 0.0    # structures consumed / step wall time
 
+    # --- data distribution (cost-model packing, train/packing.py) ---
+    # padding_waste_frac rides the inherited StepRecord field — ONE
+    # definition shared with the serving pack stats (partition.batch)
+    tier: int = 0                    # frozen capacity tier this step ran
+    edge_balance: float = 1.0        # worst mean/max edge balance across
+    #                                  mesh batch rows + window micros
+
     @staticmethod
     def training_field(record: "StepRecord", name: str, default=0.0):
         """Read a training field off a live TrainRecord OR a StepRecord

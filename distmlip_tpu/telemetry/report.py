@@ -220,6 +220,22 @@ class Report:
                 f"p95={t['grad_norm_p95']:.3g}  "
                 f"loss_scale last={t['last_loss_scale']:.3g}  "
                 f"skipped_steps={t['skipped_steps']}")
+            if "mean_padding_waste_frac" in t:
+                tiers = " ".join(
+                    f"t{k}:{v}" for k, v in sorted(
+                        t.get("steps_per_tier", {}).items()))
+                out.append(
+                    f"  packing: waste mean={t['mean_padding_waste_frac']:.2f}"
+                    f" max={t['max_padding_waste_frac']:.2f} "
+                    f"edge_balance min={t['min_edge_balance']:.2f} "
+                    f"tiers={t['n_tiers']}"
+                    + (f" steps[{tiers}]" if tiers else ""))
+                by_ep = t.get("waste_by_epoch", {})
+                if by_ep:
+                    shown = sorted(by_ep)[:8]
+                    out.append("  waste by epoch: " + " ".join(
+                        f"{e}={by_ep[e]:.2f}" for e in shown)
+                        + (" ..." if len(by_ep) > 8 else ""))
         if ("max_hbm_used_frac" in c or "max_est_peak_bytes" in c):
             bits = []
             if "max_hbm_used_frac" in c:
@@ -610,6 +626,26 @@ def aggregate(
         }
         if vals:
             t["best_val_loss"] = min(vals)
+        # data-distribution section: only when some producer measured
+        # waste (older writers carry 0.0 everywhere — no packing lines)
+        wastes = [float(tf(r, "padding_waste_frac", 0.0)) for r in train]
+        if any(w > 0 for w in wastes):
+            balances = [float(tf(r, "edge_balance", 1.0)) for r in train]
+            tiers = [int(tf(r, "tier", 0)) for r in train]
+            per_tier: dict[int, int] = {}
+            for x in tiers:
+                per_tier[x] = per_tier.get(x, 0) + 1
+            by_epoch: dict[int, list] = {}
+            for r, w in zip(train, wastes):
+                by_epoch.setdefault(int(tf(r, "epoch", 0)), []).append(w)
+            t.update(
+                mean_padding_waste_frac=sum(wastes) / len(wastes),
+                max_padding_waste_frac=max(wastes),
+                min_edge_balance=min(balances),
+                n_tiers=len(per_tier),
+                steps_per_tier=per_tier,
+                waste_by_epoch={e: sum(ws) / len(ws)
+                                for e, ws in by_epoch.items()})
         c["training"] = t
         # skipped-step dominance: the dynamic loss scale exists to absorb
         # the OCCASIONAL overflow — a run skipping a large fraction of its
@@ -621,6 +657,21 @@ def aggregate(
                 f"nonfinite grads — loss scale thrashing or divergence "
                 f"(last scale {t['last_loss_scale']:.3g}); lower the LR "
                 f"or the initial loss scale"))
+        # padding-waste dominance: over half of every padded compute
+        # array is masked lanes — the run spends most of its FLOPs on
+        # padding, which is the arXiv 2504.10700 data-distribution
+        # failure mode the cost-model packer exists to remove
+        mean_w = t.get("mean_padding_waste_frac", 0.0)
+        if len(train) >= 4 and mean_w > 0.5:
+            rep.anomalies.append(Anomaly(
+                "padding_waste_dominant", 0,
+                f"mean train padding_waste_frac {mean_w:.2f} over "
+                f"{len(train)} step(s) (> 0.50) across "
+                f"{t.get('n_tiers', 1)} capacity tier(s) — the frozen "
+                f"caps dwarf the live graphs; switch the loader to "
+                f"packing='cost_model' / add a capacity tier "
+                f"(train/packing.py), or audit the dataset with "
+                f"tools/pack_audit.py"))
 
     # --- anomalies ---
     # stall detection is PER KIND: a DeviceMD chunk legitimately takes

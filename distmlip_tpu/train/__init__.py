@@ -39,7 +39,10 @@ Quick start::
 
 from .checkpoint import TrainCheckpointer, latest_checkpoint
 from .data import (PackedBatchLoader, Sample, TrainBatch, epoch_permutation,
-                   labelled_dataset, pack_targets)
+                   labelled_dataset, pack_targets, structure_needs)
+from .packing import (CostCensus, assign_tiers, default_cost, model_cost_fn,
+                      plan_epoch, plan_epoch_naive, predicted_plan_waste,
+                      tier_caps)
 from .legacy import (load_train_state, make_batched_train_step, make_eval_fn,
                      make_loss_fn, make_train_step, save_train_state,
                      stack_graphs, stack_targets)
@@ -65,6 +68,16 @@ __all__ = [
     "TrainBatch",
     "pack_targets",
     "epoch_permutation",
+    "structure_needs",
+    # cost-model packing (train/packing.py)
+    "CostCensus",
+    "assign_tiers",
+    "default_cost",
+    "model_cost_fn",
+    "plan_epoch",
+    "plan_epoch_naive",
+    "predicted_plan_waste",
+    "tier_caps",
     # step
     "TrainConfig",
     "TrainState",

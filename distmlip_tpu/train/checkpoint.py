@@ -33,9 +33,13 @@ BEST_NAME = "best.npz"
 
 def _loader_state_tree(loader_state: dict | None) -> dict:
     s = loader_state or {}
+    # "tier" is the cost-model loader's derived tier coordinate (PR 15);
+    # naive loaders save 0 and ignore it on restore, the tiered loader
+    # VALIDATES it against its recomputed plan (set_state raises on drift)
     return {"seed": np.int64(s.get("seed", 0)),
             "epoch": np.int64(s.get("epoch", 0)),
-            "step": np.int64(s.get("step", 0))}
+            "step": np.int64(s.get("step", 0)),
+            "tier": np.int64(s.get("tier", 0))}
 
 
 def latest_checkpoint(directory: str) -> str | None:
@@ -128,7 +132,14 @@ class TrainCheckpointer:
     # ---- reading ----
 
     def _load(self, state_like, path):
-        tree = load_params(path, like=self._payload(state_like, None))
+        like = self._payload(state_like, None)
+        # pre-tier checkpoints (PR 10) lack the loader tier coordinate —
+        # restore them with the 3-integer cursor template they were saved
+        # with (set_state treats a missing tier as "don't validate")
+        with np.load(path, allow_pickle=False) as z:
+            if "loader/tier" not in z.files:
+                like["loader"].pop("tier", None)
+        tree = load_params(path, like=like)
         best = float(tree.get("best_metric", np.inf))
         if np.isfinite(best) and (self.best_metric is None
                                   or best < self.best_metric):

@@ -17,6 +17,15 @@ top:
   and packs EVERY batch of every epoch at those frozen shapes: one step
   executable per accumulation window for the whole run, under the same
   logarithmic ladder quantization serving uses;
+- **cost-model packing** (``packing="cost_model"``) — on long-tail size
+  distributions ONE frozen worst case pays the 99th-percentile padding on
+  every step, so the loader can instead census per-structure cost from
+  the analytic FLOP model (edges are the unit of work), cluster the cost
+  histogram into 2–3 frozen capacity TIERS (train/packing.py), and
+  bin-pack each epoch so total edges balance across micro-batches and
+  mesh batch rows. Compile count stays pinned at <= the tier count; the
+  cursor grows a (derived) tier coordinate and resume stays bitwise —
+  the epoch plan is a pure function of ``(seed, epoch)``;
 - **target packing** — energies/forces/stresses land in the padded local
   layout of the graph they train against (owned-row force masks via
   ``atom_slots``; strain-gradient stress slots via ``structure_slots``);
@@ -43,6 +52,7 @@ from ..neighbors import neighbor_list
 from ..partition import (BucketPolicy, bucket_key, fixed_caps_for_batches,
                          pack_structures)
 from ..partition.partitioner import build_plan
+from .packing import CostCensus, assign_tiers, plan_epoch, tier_caps
 
 
 class Sample(NamedTuple):
@@ -73,6 +83,31 @@ def epoch_permutation(n: int, seed: int, epoch: int) -> np.ndarray:
     of (seed, epoch) — no hidden generator state — so any consumer
     (loader, resume, tests) recomputes the identical permutation."""
     return np.random.default_rng([int(seed), int(epoch)]).permutation(n)
+
+
+def structure_needs(atoms_list, cutoff: float, bond_cutoff: float = 0.0,
+                    use_bond_graph: bool = False,
+                    num_threads=None) -> list[dict]:
+    """Per-structure capacity needs (single-partition plan counts) — the
+    dataset census the frozen-cap AND cost-model packers both build from.
+    Module-level so tools (pack_audit) can census a dataset without
+    constructing a loader."""
+    needs = []
+    b_r = bond_cutoff if use_bond_graph else 0.0
+    for a in atoms_list:
+        nl = neighbor_list(a.positions, a.cell, a.pbc, cutoff,
+                           bond_r=b_r, num_threads=num_threads)
+        plan = build_plan(nl, a.cell, a.pbc, 1, cutoff, b_r,
+                          use_bond_graph)
+        need = {"nodes": len(a.positions),
+                "edges": len(plan.src_local[0])}
+        if use_bond_graph:
+            need.update(
+                bonds=int(plan.bond_markers[0][-1]),
+                lines=len(plan.line_src[0]),
+                bond_map=len(plan.bond_mapping_edge[0]))
+        needs.append(need)
+    return needs
 
 
 @dataclass
@@ -161,9 +196,23 @@ class PackedBatchLoader:
     make the worst-case pre-computation structure-dependent), which keeps
     compiles logarithmic rather than exactly one.
 
-    The cursor is ``state() -> {"seed", "epoch", "step"}``; ``set_state``
-    repositions the stream EXACTLY (the prefetcher restarts from the new
-    cursor). ``close()`` stops the background builder.
+    ``packing`` selects the micro-batch assembly policy:
+
+    - ``"naive"`` (default, the PR 10 behavior): contiguous permutation
+      slices packed at ONE frozen worst-case capacity set;
+    - ``"cost_model"``: the train/packing.py pipeline — per-structure
+      cost census (``cost_fn``; default edge count, or
+      :func:`~distmlip_tpu.train.packing.model_cost_fn` for the analytic
+      FLOP model), up to ``num_tiers`` frozen capacity tiers clustered
+      from the cost histogram, and seed-stable edge-balanced bin-packing
+      per epoch. Every accumulation window stays within one tier, so the
+      run compiles at most ``num_tiers`` step executables.
+
+    The cursor is ``state() -> {"seed", "epoch", "step"[, "tier"]}`` (the
+    tier coordinate is DERIVED from the plan — recorded for validation
+    and observability, not an independent degree of freedom);
+    ``set_state`` repositions the stream EXACTLY (the prefetcher restarts
+    from the new cursor). ``close()`` stops the background builder.
     """
 
     def __init__(self, samples, cutoff: float, micro_batch_size: int,
@@ -172,7 +221,9 @@ class PackedBatchLoader:
                  seed: int = 0, shuffle: bool = True, batch_parts: int = 1,
                  spatial_parts: int = 1, system: dict | None = None,
                  num_threads: int | None = None, prefetch: int = 2,
-                 dtype=np.float32, precomputed_needs=None):
+                 dtype=np.float32, precomputed_needs=None,
+                 packing: str = "naive", num_tiers: int = 2,
+                 cost_fn=None):
         if not samples:
             raise ValueError("PackedBatchLoader needs at least one sample")
         B, A = int(micro_batch_size), int(accum_steps)
@@ -199,12 +250,46 @@ class PackedBatchLoader:
         self.dtype = dtype
         self._epoch = 0
         self._step = 0
+        if packing not in ("naive", "cost_model"):
+            raise ValueError(
+                f"packing must be 'naive' or 'cost_model', got {packing!r}")
+        self.packing = packing
         ladder = caps or BucketPolicy()
         # per-structure capacity needs: computed once (or handed in by a
         # caller probing several micro-batch sizes over one dataset —
         # Trainer's memory-aware auto-sizing) and frozen into the caps
         self.needs = precomputed_needs
-        if self.spatial_parts == 1:
+        self.census = None
+        self.tier_of = None
+        self.tier_caps = {}
+        # the prefetch thread (building ahead) and the consumer (cursor/
+        # state queries) both read this cache; plans are deterministic so
+        # duplicate computation is benign, but eviction needs the lock
+        self._plan_cache: dict[int, list] = {}
+        self._plan_lock = threading.Lock()
+        if packing == "cost_model":
+            if self.spatial_parts != 1:
+                raise ValueError(
+                    "packing='cost_model' needs spatial_parts == 1 (slab "
+                    "halos make frozen per-tier capacities structure-"
+                    "dependent; use the geometric ladder for spatial "
+                    "training)")
+            if self.needs is None:
+                self.needs = self.structure_needs()
+            self.census = CostCensus.from_needs(self.needs, cost_fn)
+            # every tier must fill at least one whole accumulation window
+            self.tier_of, self.tier_thresholds = assign_tiers(
+                self.census.costs, num_tiers, min_members=B * A)
+            self.tier_caps = tier_caps(self.needs, self.tier_of, B,
+                                       self.batch_parts, policy=ladder,
+                                       accum_steps=A,
+                                       costs=self.census.costs)
+            # eval packs (arbitrary held-out subsets, outside the plan's
+            # round guarantee) keep the dataset-wide worst-case caps the
+            # naive loader uses — eval compiles its own program anyway
+            self.caps = fixed_caps_for_batches(
+                self.needs, -(-B // self.batch_parts), policy=ladder)
+        elif self.spatial_parts == 1:
             if self.needs is None:
                 self.needs = self.structure_needs()
             self.caps = fixed_caps_for_batches(
@@ -221,41 +306,100 @@ class PackedBatchLoader:
     def structure_needs(self) -> list[dict]:
         """Per-structure capacity needs (single-partition plan counts) —
         computed ONCE at loader construction to freeze the run's shapes."""
-        needs = []
-        b_r = self.bond_cutoff if self.use_bond_graph else 0.0
-        for s in self.samples:
-            a = s.atoms
-            nl = neighbor_list(a.positions, a.cell, a.pbc, self.cutoff,
-                               bond_r=b_r, num_threads=self.num_threads)
-            plan = build_plan(nl, a.cell, a.pbc, 1, self.cutoff, b_r,
-                              self.use_bond_graph)
-            need = {"nodes": len(a.positions),
-                    "edges": len(plan.src_local[0])}
-            if self.use_bond_graph:
-                need.update(
-                    bonds=int(plan.bond_markers[0][-1]),
-                    lines=len(plan.line_src[0]),
-                    bond_map=len(plan.bond_mapping_edge[0]))
-            needs.append(need)
-        return needs
+        return structure_needs([s.atoms for s in self.samples], self.cutoff,
+                               self.bond_cutoff, self.use_bond_graph,
+                               self.num_threads)
+
+    # ---- the per-epoch packing plan (cost-model path) ----
+
+    def epoch_plan(self, epoch: int) -> list:
+        """The epoch's deterministic packing plan (cost-model packing
+        only) — a pure function of ``(seed, epoch)``, cached for the
+        couple of epochs the prefetcher may straddle."""
+        if self.packing != "cost_model":
+            raise ValueError("epoch_plan is only defined under "
+                             "packing='cost_model'")
+        with self._plan_lock:
+            plan = self._plan_cache.get(epoch)
+        if plan is None:
+            plan = plan_epoch(
+                self.census.costs, self.tier_of, seed=self.seed,
+                epoch=epoch, micro_batch_size=self.micro_batch_size,
+                accum_steps=self.accum_steps,
+                batch_parts=self.batch_parts, shuffle=self.shuffle)
+            with self._plan_lock:
+                self._plan_cache[epoch] = plan
+                while len(self._plan_cache) > 4:
+                    del self._plan_cache[min(self._plan_cache)]
+        return plan
+
+    @property
+    def num_tiers(self) -> int:
+        """Distinct frozen capacity tiers (1 under naive packing) — the
+        whole run's train-step compile count is bounded by this."""
+        return len(self.tier_caps) if self.packing == "cost_model" else 1
+
+    def tier_first_steps(self, epoch: int = 0) -> dict:
+        """{tier: first step index of ``epoch`` running that tier} — the
+        Trainer prices each tier's executable through the HBM planner by
+        building exactly these steps."""
+        if self.packing != "cost_model":
+            return {0: 0}
+        firsts: dict[int, int] = {}
+        for i, step in enumerate(self.epoch_plan(epoch)):
+            firsts.setdefault(step.tier, i)
+        return firsts
+
+    def step_tier(self, epoch: int, step: int) -> int:
+        """Tier of the (epoch, step) macro-batch (0 under naive packing)."""
+        if self.packing != "cost_model":
+            return 0
+        plan = self.epoch_plan(epoch)
+        if step >= len(plan):  # cursor parked on an epoch boundary
+            return self.epoch_plan(epoch + 1)[0].tier
+        return plan[step].tier
 
     # ---- cursor ----
 
     @property
     def steps_per_epoch(self) -> int:
+        if self.packing == "cost_model":
+            # per-tier window counts are a function of STATIC tier
+            # membership, so this is epoch-independent like the naive path
+            B_A = self.micro_batch_size * self.accum_steps
+            return sum(int(np.sum(self.tier_of == t)) // B_A
+                       for t in self.tier_caps)
         return len(self.samples) // (self.micro_batch_size
                                      * self.accum_steps)
 
     def state(self) -> dict:
         """The resumable cursor: batches CONSUMED so far (not built —
-        prefetched-but-undelivered batches are rebuilt on resume)."""
-        return {"seed": self.seed, "epoch": self._epoch, "step": self._step}
+        prefetched-but-undelivered batches are rebuilt on resume). Under
+        cost-model packing the cursor grows a ``tier`` coordinate — the
+        tier of the NEXT step, derived from the plan — so a resume can
+        validate that it rebuilt the same tiering the checkpoint saw."""
+        cur = {"seed": self.seed, "epoch": self._epoch, "step": self._step}
+        if self.packing == "cost_model":
+            cur["tier"] = self.step_tier(self._epoch, self._step)
+        return cur
 
     def set_state(self, state: dict) -> None:
         self.close()
         self.seed = int(state["seed"])
         self._epoch = int(state["epoch"])
         self._step = int(state["step"])
+        with self._plan_lock:
+            self._plan_cache.clear()
+        if self.packing == "cost_model" and "tier" in state:
+            want = int(state["tier"])
+            have = self.step_tier(self._epoch, self._step)
+            if want != have:
+                raise ValueError(
+                    f"loader cursor tier mismatch: checkpoint says the "
+                    f"next step runs tier {want}, this loader's plan says "
+                    f"tier {have} — the dataset, seed, micro-batch size "
+                    f"or tier configuration changed since the checkpoint "
+                    f"was written (resume would not be bitwise)")
 
     # ---- batch building ----
 
@@ -264,21 +408,33 @@ class PackedBatchLoader:
             return epoch_permutation(len(self.samples), self.seed, epoch)
         return np.arange(len(self.samples))
 
+    def _micro_indices(self, epoch: int, step: int) -> tuple[int, list]:
+        """(tier, [A index-lists]) of the (epoch, step) macro-batch under
+        the active packing policy."""
+        B, A = self.micro_batch_size, self.accum_steps
+        if self.packing == "cost_model":
+            macro = self.epoch_plan(epoch)[step]
+            return macro.tier, [list(m) for m in macro.micro]
+        order = self._order(epoch)
+        start = step * B * A
+        return 0, [list(order[start + a_i * B:start + (a_i + 1) * B])
+                   for a_i in range(A)]
+
     def _build(self, epoch: int, step: int) -> TrainBatch:
         """Build the (epoch, step) macro-batch — a pure function of the
         cursor, which is the whole resume story."""
-        B, A = self.micro_batch_size, self.accum_steps
-        order = self._order(epoch)
-        start = step * B * A
+        tier, micros = self._micro_indices(epoch, step)
+        caps = (self.tier_caps[tier] if self.packing == "cost_model"
+                else self.caps)
         graphs, targets = [], []
         n_atoms_total = 0
-        for a_i in range(A):
-            idx = order[start + a_i * B:start + (a_i + 1) * B]
+        wastes, balances, edge_totals = [], [], []
+        for idx in micros:
             batch_samples = [self.samples[i] for i in idx]
             graph, host = pack_structures(
                 [s.atoms for s in batch_samples], self.cutoff,
                 bond_cutoff=self.bond_cutoff,
-                use_bond_graph=self.use_bond_graph, caps=self.caps,
+                use_bond_graph=self.use_bond_graph, caps=caps,
                 species_fn=self.species_fn, dtype=self.dtype,
                 system=self.system, num_threads=self.num_threads,
                 spatial_parts=self.spatial_parts,
@@ -287,12 +443,29 @@ class PackedBatchLoader:
             targets.append(pack_targets(graph, host, batch_samples,
                                         dtype=self.dtype))
             n_atoms_total += int(sum(len(s.forces) for s in batch_samples))
+            stats = host.stats or {}
+            wastes.append(float(stats.get("padding_waste_frac", 0.0)))
+            rows = stats.get("n_edges_per_part") or []
+            edge_totals.append(float(sum(rows)))
+            if rows and max(rows) > 0:
+                balances.append(sum(rows) / len(rows) / max(rows))
+        # edge balance: rows within each micro-batch AND micro-batches
+        # within the window — 1.0 means no device/scan-slot ever waits on
+        # a heavier sibling
+        balance = min(balances) if balances else 1.0
+        if edge_totals and max(edge_totals) > 0:
+            balance = min(balance, sum(edge_totals) / len(edge_totals)
+                          / max(edge_totals))
+        B, A = self.micro_batch_size, self.accum_steps
         return TrainBatch(
             graphs=_stack_host(graphs),
             targets=_stack_host(targets),
-            meta={"epoch": epoch, "step": step,
+            meta={"epoch": epoch, "step": step, "tier": tier,
                   "bucket_key": bucket_key(graphs[0]),
-                  "n_structures": B * A, "n_atoms": n_atoms_total})
+                  "n_structures": B * A, "n_atoms": n_atoms_total,
+                  "padding_waste_frac": (sum(wastes) / len(wastes)
+                                         if wastes else 0.0),
+                  "edge_balance": balance})
 
     def _advance(self, epoch: int, step: int) -> tuple[int, int]:
         step += 1

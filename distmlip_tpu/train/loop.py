@@ -112,6 +112,7 @@ class Trainer:
             budget = device_bytes_limit()
         self.hbm_budget_bytes = budget
         self.est_peak_bytes = 0
+        self.tier_peak_bytes: dict[int, int] = {}
         self.loader = self._size_loader(samples, cutoff, micro_batch_size,
                                         budget, hbm_budget_frac, lk)
 
@@ -124,6 +125,14 @@ class Trainer:
     # ---- memory-aware micro-batch sizing ----
 
     def _probe_loader(self, samples, cutoff, B, lk, needs):
+        lk = dict(lk)
+        # a caller may hand a precomputed dataset census through
+        # loader_kwargs (e.g. bench.py's naive-vs-cost-model A/B shares
+        # one census across two Trainers); an in-sizing-loop census from
+        # a previous candidate wins — both are the same dataset property
+        needs = needs if needs is not None else lk.pop(
+            "precomputed_needs", None)
+        lk.pop("precomputed_needs", None)
         return PackedBatchLoader(samples, cutoff, micro_batch_size=B,
                                  precomputed_needs=needs, **lk)
 
@@ -171,8 +180,26 @@ class Trainer:
             f"model/accumulation window or raise hbm_budget_frac")
 
     def _estimate(self, loader) -> int:
-        batch = loader._build(0, 0)
-        return estimate_step_peak_bytes(self.step_fn, self.state, batch)
+        # price EVERY frozen capacity tier up front (cost-model packing
+        # compiles one executable per tier; each must fit the budget, and
+        # the gate compares against the most expensive one). The naive
+        # loader reports a single tier {0: 0}.
+        self.tier_peak_bytes = {}
+        for tier, step in sorted(loader.tier_first_steps().items()):
+            batch = loader._build(0, step)
+            self.tier_peak_bytes[tier] = estimate_step_peak_bytes(
+                self.step_fn, self.state, batch)
+        return max(self.tier_peak_bytes.values())
+
+    @property
+    def compile_count(self) -> int:
+        """Train-step executables compiled so far (jit cache entries) —
+        pinned <= ``loader.num_tiers`` for the whole run (every tier's
+        shapes are frozen; -1 when the jit internals are unavailable)."""
+        try:
+            return int(self.step_fn._cache_size())
+        except Exception:  # noqa: BLE001 - introspection-only surface
+            return -1
 
     # ---- the loop ----
 
@@ -197,8 +224,12 @@ class Trainer:
         # every retry of the same applied step would hammer exactly the
         # run that is already struggling
         advanced = not m["skipped"]
+        tier = int(batch.meta.get("tier", 0))
         m.update(epoch=epoch, examples_per_sec=(
-            batch.meta.get("n_structures", 0) / max(dt, 1e-9)))
+            batch.meta.get("n_structures", 0) / max(dt, 1e-9)),
+            tier=tier,
+            padding_waste_frac=batch.meta.get("padding_waste_frac", 0.0),
+            edge_balance=batch.meta.get("edge_balance", 1.0))
 
         if self._val_batch is not None and self._due(step_no, batch,
                                                      self.eval_every,
@@ -215,6 +246,11 @@ class Trainer:
                                    step=step_no)
 
         if self.telemetry is not None:
+            # per-tier executables are priced separately; report the one
+            # THIS step dispatched (falling back to the run max) and
+            # derive headroom from the SAME estimate so the record stays
+            # self-consistent (record.py: 1 - est_peak_bytes / limit)
+            tier_est = self.tier_peak_bytes.get(tier, self.est_peak_bytes)
             rec = TrainRecord(
                 step=step_no, epoch=epoch,
                 timings={"data_s": t_data, "device_s": dt - t_data,
@@ -230,10 +266,13 @@ class Trainer:
                 batch_size=batch.meta.get("n_structures", 0),
                 n_atoms=batch.meta.get("n_atoms", 0),
                 bucket_key=batch.meta.get("bucket_key", ""),
-                est_peak_bytes=self.est_peak_bytes,
+                tier=tier,
+                padding_waste_frac=m["padding_waste_frac"],
+                edge_balance=m["edge_balance"],
+                est_peak_bytes=tier_est,
                 hbm_headroom_frac=(
-                    1.0 - self.est_peak_bytes / self.hbm_budget_bytes
-                    if self.hbm_budget_bytes and self.est_peak_bytes
+                    1.0 - tier_est / self.hbm_budget_bytes
+                    if self.hbm_budget_bytes and tier_est
                     else 0.0),
             )
             if self.mesh is not None:

@@ -42,8 +42,8 @@ served_params = model.init(jax.random.PRNGKey(0))
 teacher = BatchedPotential(model, served_params)
 
 
-def structure(noise):
-    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.8, (2, 2, 2))
+def structure(noise, reps=(2, 2, 2)):
+    frac, lattice = geometry.make_supercell(unit, np.eye(3) * 3.8, reps)
     cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
         0, noise, (len(frac), 3))
     return Atoms(numbers=rng.integers(1, 4, len(cart)), positions=cart,
@@ -51,7 +51,12 @@ def structure(noise):
 
 
 # --- label a dataset with the served model (the serve side of the loop) --
-pool = [structure(0.03 + 0.02 * (i % 3)) for i in range(10)]
+# deliberately LONG-TAIL sizes (mostly small cells, a few large): the
+# regime where one frozen worst-case capacity wastes most of its padded
+# slots, and the cost-model loader's capacity tiers pay off
+pool = [structure(0.03 + 0.02 * (i % 3),
+                  reps=(2, 2, 2) if i % 2 else (1, 1, 1))
+        for i in range(10)]
 results = teacher.calculate(pool)
 dataset = [Sample(a, float(r["energy"]), np.asarray(r["forces"], np.float32))
            for a, r in zip(pool, results)]
@@ -68,17 +73,38 @@ drifted = jax.tree.map(
 ckpt_dir = tempfile.mkdtemp(prefix="distmlip-train-")
 trainer = Trainer(
     model.energy_fn, drifted, optax.adam(2e-3), train_set, cfg.cutoff,
-    micro_batch_size="auto",            # sized by the static HBM planner
+    micro_batch_size=2,
     hbm_budget_bytes=1 << 32,           # 4 GiB budget for the demo
     config=TrainConfig(accum_steps=2, ema_decay=0.99, clip_norm=1.0),
     val_samples=val_set, eval_every=4,
     checkpoint_dir=ckpt_dir, checkpoint_every=4,
+    # cost-model packing: census the dataset, cluster 2 frozen capacity
+    # tiers, bin-pack each epoch to balance edges (train/packing.py) —
+    # every tier is priced by the HBM planner before any compile
     loader_kwargs={"species_fn": lambda z: (z - 1).astype(np.int32),
-                   "seed": 42},
+                   "seed": 42, "packing": "cost_model", "num_tiers": 2},
 )
-print(f"micro_batch={trainer.loader.micro_batch_size} (auto), "
-      f"est peak {trainer.est_peak_bytes / 2**20:.1f} MiB, "
+print(f"micro_batch={trainer.loader.micro_batch_size}, "
+      f"est peak {trainer.est_peak_bytes / 2**20:.1f} MiB "
+      f"({len(trainer.tier_peak_bytes)} tier(s)), "
       f"{trainer.steps_per_epoch} steps/epoch")
+
+# padding waste before/after: what the frozen single-cap loader WOULD
+# have paid on this long-tail dataset vs what the tiers actually pay
+from distmlip_tpu.partition import fixed_caps_for_batches
+from distmlip_tpu.train import plan_epoch_naive, predicted_plan_waste
+
+loader = trainer.loader
+naive_waste = predicted_plan_waste(
+    loader.needs,
+    plan_epoch_naive(len(train_set), seed=42, epoch=0, micro_batch_size=2,
+                     accum_steps=2),
+    {0: fixed_caps_for_batches(loader.needs, 2)})
+tiered_waste = predicted_plan_waste(
+    loader.needs, loader.epoch_plan(0), loader.tier_caps)
+print(f"padding waste: naive single-cap {naive_waste:.2f} -> "
+      f"cost-model tiers {tiered_waste:.2f} "
+      f"({naive_waste / max(tiered_waste, 1e-9):.1f}x less padding)")
 
 val0 = trainer.evaluate()["loss"]
 history = trainer.fit(epochs=8)
@@ -94,8 +120,10 @@ resumed = Trainer(
     micro_batch_size=trainer.loader.micro_batch_size,
     config=TrainConfig(accum_steps=2, ema_decay=0.99, clip_norm=1.0),
     checkpoint_dir=ckpt_dir,
+    # same packing config: the checkpoint's tier coordinate is VALIDATED
+    # against the resumed loader's recomputed plan (drift -> hard error)
     loader_kwargs={"species_fn": lambda z: (z - 1).astype(np.int32),
-                   "seed": 42},
+                   "seed": 42, "packing": "cost_model", "num_tiers": 2},
 )
 step_no = resumed.restore()
 m = resumed.train_step()

@@ -1,0 +1,446 @@
+"""Cost-model-driven batch packing (distmlip_tpu/train/packing.py).
+
+The load-bearing invariants, each pinned:
+
+- the serving pack stats and the training loader compute padding waste
+  through ONE shared implementation (``partition.slot_waste_frac``), and
+  the analytic prediction equals the built pack's measured number;
+- the tiered plan is seed-stable: same ``(seed, epoch)`` => byte-identical
+  micro-batches, and a mid-epoch resume ACROSS a tier boundary is bitwise
+  identical to the uninterrupted run;
+- long-tail adversarial: one giant structure must not inflate every
+  batch's caps — and on a lognormal >= 200-structure dataset the
+  cost-model loader cuts padding waste by >= 2x vs the frozen single cap
+  (the ISSUE's acceptance bar);
+- equal-loss parity: cost-model packing reorders structures WITHIN an
+  accumulation window, and the summed gradient is order-independent, so
+  the loss trajectory matches naive packing to fp32 roundoff;
+- compile discipline: a whole tiered run compiles at most one train-step
+  executable per tier;
+- the tiered train-step programs trace clean through every registered
+  contract pass with the same config (no per-tier contract drift);
+- tools/pack_audit.py is CI-pinned: exit 0 under a generous waste bound,
+  exit 3 when the bound (or the HBM budget) is violated.
+"""
+
+import importlib.util
+import os
+import sys
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distmlip_tpu import geometry
+from distmlip_tpu.calculators import Atoms
+from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+from distmlip_tpu.partition import (fixed_caps_for_batches, graph_live_slots,
+                                    pack_structures, packed_stats,
+                                    slot_waste_frac)
+from distmlip_tpu.train import (PackedBatchLoader, Sample, TrainConfig,
+                                Trainer, assign_tiers, init_train_state,
+                                make_accum_train_step, plan_epoch,
+                                plan_epoch_naive, predicted_plan_waste,
+                                structure_needs, tier_caps)
+from distmlip_tpu.train.packing import CostCensus, default_cost
+
+pytestmark = pytest.mark.train
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+UNIT = np.array([[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]])
+CFG = TensorNetConfig(num_species=3, units=8, num_rbf=4, num_layers=1,
+                      cutoff=3.2)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def species_fn(z):
+    return (z - 1).astype(np.int32)
+
+
+def make_samples(rng, n, reps, n_species=3, a=3.6):
+    frac, lat = geometry.make_supercell(UNIT, np.eye(3) * a, reps)
+    out = []
+    for _ in range(n):
+        cart = geometry.frac_to_cart(frac, lat) + rng.normal(
+            0, 0.05, (len(frac), 3))
+        atoms = Atoms(numbers=rng.integers(1, 1 + n_species, len(frac)),
+                      positions=cart, cell=lat)
+        out.append(Sample(
+            atoms, float(rng.normal()),
+            rng.normal(0, 0.1, (len(frac), 3)).astype(np.float32)))
+    return out
+
+
+@pytest.fixture(scope="module")
+def longtail_samples():
+    """8 small + 4 large structures — two clear tiers."""
+    rng = np.random.default_rng(7)
+    return make_samples(rng, 8, (1, 1, 1)) + make_samples(rng, 4, (2, 2, 2))
+
+
+def _loader(samples, **kw):
+    kw.setdefault("micro_batch_size", 2)
+    kw.setdefault("species_fn", species_fn)
+    kw.setdefault("seed", 11)
+    kw.setdefault("prefetch", 0)
+    return PackedBatchLoader(samples, CFG.cutoff, **kw)
+
+
+# ---------------------------------------------------------------------------
+# one waste definition
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_waste_shared_implementation(longtail_samples):
+    """Serving stats, the training meta AND the analytic prediction all
+    route through slot_waste_frac — the three must agree exactly."""
+    batch = longtail_samples[:3]
+    graph, host = pack_structures([s.atoms for s in batch], CFG.cutoff,
+                                  species_fn=species_fn)
+    stats = packed_stats(graph, len(batch))
+    live, slots = graph_live_slots(graph)
+    assert stats["padding_waste_frac"] == slot_waste_frac(live, slots)
+
+    # the loader's per-step meta equals the mean of its packs' stats,
+    # and the packing module predicts the identical number from the
+    # needs census alone (same caps, same census -> same waste)
+    ld = _loader(longtail_samples, accum_steps=2,
+                 packing="cost_model", num_tiers=2)
+    plan = ld.epoch_plan(0)
+    b = ld.next_batch()
+    step0 = plan[0]
+    predicted = predicted_plan_waste(ld.needs, [step0], ld.tier_caps,
+                                     batch_parts=1)
+    assert b.meta["padding_waste_frac"] == pytest.approx(predicted,
+                                                         abs=1e-12)
+    ld.close()
+
+
+@pytest.mark.tier1
+def test_census_and_default_cost(longtail_samples):
+    needs = structure_needs([s.atoms for s in longtail_samples],
+                            CFG.cutoff)
+    census = CostCensus.from_needs(needs)
+    assert len(census.costs) == len(longtail_samples)
+    # edges dominate the default cost
+    assert default_cost({"edges": 100, "nodes": 10}) == pytest.approx(101.0)
+    assert census.skew() > 1.5  # the long tail is visible
+    assert "cost census" in census.render()
+
+
+# ---------------------------------------------------------------------------
+# tier selection: the long-tail adversarial case
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_giant_structure_does_not_inflate_small_tier(longtail_samples):
+    """One giant structure must only inflate the windows that contain it:
+    the small tier's frozen caps stay far below the single-cap loader's."""
+    ld_naive = _loader(longtail_samples)
+    ld_cost = _loader(longtail_samples, packing="cost_model", num_tiers=2)
+    naive_caps = ld_naive.caps.as_dict()
+    small_caps = ld_cost.tier_caps[0].as_dict()
+    big_caps = ld_cost.tier_caps[max(ld_cost.tier_caps)].as_dict()
+    assert small_caps["edges"] < naive_caps["edges"] / 2
+    assert big_caps["edges"] <= naive_caps["edges"]
+    # tier membership: every small structure in tier 0, giants on top
+    sizes = np.array([len(s.atoms.positions) for s in longtail_samples])
+    assert set(np.asarray(ld_cost.tier_of)[sizes == sizes.min()]) == {0}
+    ld_naive.close()
+    ld_cost.close()
+
+
+@pytest.mark.tier1
+def test_assign_tiers_min_members_and_ties():
+    # 15 equal + 1 giant, min_members=4: the giant cannot claim its own
+    # tier — it folds into a >= 4-member top tier
+    costs = np.array([10.0] * 15 + [1000.0])
+    tier_of, thr = assign_tiers(costs, 3, min_members=4)
+    assert tier_of[-1] == max(tier_of)
+    top = int(np.sum(tier_of == max(tier_of)))
+    assert top >= 4
+    # all-equal costs: one tier, no spurious boundaries
+    tier_of, thr = assign_tiers(np.full(12, 5.0), 3, min_members=2)
+    assert set(tier_of) == {0} and thr == [5.0]
+
+
+@pytest.mark.tier1
+def test_longtail_lognormal_waste_reduction_2x():
+    """The acceptance bar: on a lognormal long-tail dataset of >= 200
+    structures, cost-model packing cuts predicted padding waste >= 2x vs
+    the frozen single-cap loader (the same caps/census arithmetic the
+    loader packs with — test_waste_shared_implementation pins predicted
+    == measured)."""
+    pack_audit = _load_tool("pack_audit")
+    samples = pack_audit.synth_longtail_samples(
+        200, seed=5, mu=3.0, sigma=1.0, min_atoms=4, max_atoms=600)
+    needs = structure_needs([s.atoms for s in samples], 3.5)
+    census = CostCensus.from_needs(needs)
+    B = 8
+    tier_of, _thr = assign_tiers(census.costs, 3, min_members=B)
+    caps = tier_caps(needs, tier_of, B, costs=census.costs)
+    naive_caps = fixed_caps_for_batches(needs, B)
+    plan = plan_epoch(census.costs, tier_of, seed=5, epoch=0,
+                      micro_batch_size=B)
+    naive_plan = plan_epoch_naive(len(needs), seed=5, epoch=0,
+                                  micro_batch_size=B)
+    w_cost = predicted_plan_waste(needs, plan, caps)
+    w_naive = predicted_plan_waste(needs, naive_plan, {0: naive_caps})
+    assert w_naive >= 2.0 * w_cost, (w_naive, w_cost)
+
+
+@pytest.mark.tier1
+def test_edge_balance_beats_naive(longtail_samples):
+    """The bin-packer's micro-batches carry balanced edge totals where
+    the permutation slicer's do not."""
+    needs = structure_needs([s.atoms for s in longtail_samples],
+                            CFG.cutoff)
+    census = CostCensus.from_needs(needs)
+
+    def window_spread(plan):
+        worst = 1.0
+        for step in plan:
+            tots = [sum(census.costs[list(m)]) for m in step.micro]
+            if max(tots) > 0:
+                worst = min(worst, min(tots) / max(tots))
+        return worst
+
+    tier_of, _ = assign_tiers(census.costs, 1, min_members=4)
+    cost_plan = plan_epoch(census.costs, tier_of, seed=3, epoch=0,
+                           micro_batch_size=2, accum_steps=2)
+    naive_plan = plan_epoch_naive(len(needs), seed=3, epoch=0,
+                                  micro_batch_size=2, accum_steps=2)
+    assert window_spread(cost_plan) >= window_spread(naive_plan)
+    assert window_spread(cost_plan) > 0.5
+
+
+# ---------------------------------------------------------------------------
+# determinism + resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_tiered_loader_seed_stable_replay(longtail_samples):
+    """Same (seed, epoch) => byte-identical micro-batches, across an
+    epoch boundary, fresh loader or repositioned cursor."""
+    ld1 = _loader(longtail_samples, packing="cost_model", num_tiers=2)
+    ld2 = _loader(longtail_samples, packing="cost_model", num_tiers=2)
+    batches = []
+    for _ in range(ld1.steps_per_epoch + 2):  # crosses the epoch edge
+        b1, b2 = ld1.next_batch(), ld2.next_batch()
+        batches.append(b1)
+        for x, y in zip(jax.tree.leaves((b1.graphs, b1.targets)),
+                        jax.tree.leaves((b2.graphs, b2.targets))):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert b1.meta["tier"] == b2.meta["tier"]
+    # reposition mid-epoch and replay batch 1 exactly
+    ld2.set_state({"seed": 11, "epoch": 0, "step": 1})
+    b1r = ld2.next_batch()
+    for x, y in zip(jax.tree.leaves(batches[1].graphs),
+                    jax.tree.leaves(b1r.graphs)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # the per-epoch shuffle is live: epoch 1's plan differs from epoch 0's
+    assert ld1.epoch_plan(0) != ld1.epoch_plan(1)
+    ld1.close()
+    ld2.close()
+
+
+@pytest.mark.tier1
+def test_tiered_cursor_carries_tier_and_validates(longtail_samples):
+    ld = _loader(longtail_samples, packing="cost_model", num_tiers=2)
+    st = ld.state()
+    assert st["tier"] == ld.epoch_plan(0)[0].tier
+    # a cursor whose tier contradicts the recomputed plan is REJECTED
+    # (dataset/seed/tiering drifted => resume would not be bitwise)
+    other = 1 - st["tier"]
+    with pytest.raises(ValueError, match="tier mismatch"):
+        ld.set_state({**st, "tier": other})
+    ld.close()
+
+
+@pytest.mark.tier1
+def test_trainer_resume_bitwise_across_tier_boundary(longtail_samples,
+                                                     tmp_path):
+    """The PR 10 bitwise-resume contract extended to the tiered loader:
+    save mid-epoch, continue across a tier boundary, restore into a fresh
+    Trainer — losses and final params identical to the uninterrupted run."""
+    model = TensorNet(CFG)
+    params = model.init(jax.random.PRNGKey(0))
+
+    def trainer():
+        return Trainer(
+            model.energy_fn, params, optax.adam(3e-3), longtail_samples,
+            CFG.cutoff, micro_batch_size=2,
+            config=TrainConfig(ema_decay=0.99),
+            checkpoint_dir=str(tmp_path / "ckpts"),
+            loader_kwargs={"species_fn": species_fn, "seed": 13,
+                           "packing": "cost_model", "num_tiers": 2})
+
+    t1 = trainer()
+    tiers = [t1.loader.epoch_plan(0)[i].tier
+             for i in range(t1.steps_per_epoch)]
+    assert len(set(tiers)) == 2  # both tiers appear within the epoch
+    for _ in range(2):
+        t1.train_step()
+    path = t1.save_checkpoint()
+    cursor = dict(t1.loader.state())
+    cont = [t1.train_step()["loss"] for _ in range(3)]
+    end1 = np.asarray(jax.flatten_util.ravel_pytree(t1.state.params)[0])
+    t1.close()
+
+    t2 = trainer()
+    t2.restore(path)
+    assert t2.loader.state() == cursor
+    cont2 = [t2.train_step()["loss"] for _ in range(3)]
+    end2 = np.asarray(jax.flatten_util.ravel_pytree(t2.state.params)[0])
+    t2.close()
+    assert cont == cont2, (cont, cont2)
+    np.testing.assert_array_equal(end1, end2)
+
+
+# ---------------------------------------------------------------------------
+# equal-loss parity + compile discipline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_equal_loss_parity_within_accum_window():
+    """With B * A = N the whole dataset is ONE optimizer step; cost-model
+    packing only reorders which structures share a micro-batch, and the
+    summed gradient over the window is order-independent — the two loss
+    trajectories match to fp32 roundoff."""
+    rng = np.random.default_rng(3)
+    samples = make_samples(rng, 8, (2, 2, 1))
+    model = TensorNet(CFG)
+    params = model.init(jax.random.PRNGKey(9))
+    opt = optax.sgd(0.05)
+    outs = {}
+    for mode, kw in (("naive", {}),
+                     ("cost", {"packing": "cost_model", "num_tiers": 1})):
+        ld = _loader(samples, micro_batch_size=2, accum_steps=4,
+                     seed=5, **kw)
+        state = init_train_state(opt, params, None, TrainConfig(), seed=0)
+        step = make_accum_train_step(model.energy_fn, opt, None,
+                                     TrainConfig(accum_steps=4),
+                                     donate=False)
+        losses = []
+        for _ in range(3):
+            b = ld.next_batch()
+            state, m = step(state, b.graphs, b.targets)
+            losses.append(float(m["loss"]))
+        outs[mode] = (losses, state)
+        ld.close()
+    ln, lc = outs["naive"][0], outs["cost"][0]
+    np.testing.assert_allclose(ln, lc, rtol=1e-4)
+    fa = np.asarray(
+        jax.flatten_util.ravel_pytree(outs["naive"][1].params)[0])
+    fb = np.asarray(
+        jax.flatten_util.ravel_pytree(outs["cost"][1].params)[0])
+    assert np.abs(fa - fb).max() <= 1e-5 * max(np.abs(fb).max(), 1.0)
+
+
+@pytest.mark.tier1
+def test_compile_count_bounded_by_tiers(longtail_samples):
+    """A full tiered epoch compiles at most one step executable per tier."""
+    model = TensorNet(CFG)
+    params = model.init(jax.random.PRNGKey(0))
+    t = Trainer(model.energy_fn, params, optax.adam(1e-3),
+                longtail_samples, CFG.cutoff, micro_batch_size=2,
+                loader_kwargs={"species_fn": species_fn, "seed": 2,
+                               "packing": "cost_model", "num_tiers": 2})
+    assert t.loader.num_tiers == 2
+    assert sorted(t.tier_peak_bytes) == sorted(t.loader.tier_caps)
+    assert all(v > 0 for v in t.tier_peak_bytes.values())
+    t.fit(epochs=1)
+    assert 0 < t.compile_count <= t.loader.num_tiers
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# telemetry: packing section + padding_waste_dominant anomaly
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_report_packing_section_and_waste_anomaly():
+    from distmlip_tpu.telemetry import TrainRecord
+    from distmlip_tpu.telemetry.report import aggregate
+
+    good = [TrainRecord(step=i, loss=1.0, padding_waste_frac=0.2,
+                        edge_balance=0.9, tier=i % 2,
+                        timings={"total_s": 0.1}) for i in range(4)]
+    rep = aggregate(good)
+    t = rep.counters["training"]
+    assert t["mean_padding_waste_frac"] == pytest.approx(0.2)
+    assert t["n_tiers"] == 2 and t["min_edge_balance"] == 0.9
+    assert "packing: waste mean=0.20" in rep.render()
+    assert not any(a.kind == "padding_waste_dominant"
+                   for a in rep.anomalies)
+
+    bad = [TrainRecord(step=i, loss=1.0, padding_waste_frac=0.8,
+                       timings={"total_s": 0.1}) for i in range(6)]
+    rep2 = aggregate(bad)
+    assert any(a.kind == "padding_waste_dominant" for a in rep2.anomalies)
+    # JSONL roundtrip: packing fields survive reparse as StepRecord
+    from distmlip_tpu.telemetry import StepRecord
+    back = StepRecord.from_json(good[1].to_json())
+    assert TrainRecord.training_field(back, "edge_balance") == 0.9
+    assert TrainRecord.training_field(back, "tier") == 1
+
+
+# ---------------------------------------------------------------------------
+# tiered contract programs + pack_audit CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tier1
+def test_tiered_train_step_contracts():
+    """Both tier executables trace clean through every registered pass
+    under the SAME config — no per-tier contract drift."""
+    from distmlip_tpu.analysis import error_count, get_passes, run_passes
+
+    cc = _load_tool("contract_check")
+    programs = []
+    cc._trace_train_step_tiers(programs)
+    names = sorted(p.name for p in programs)
+    assert names == ["train_step[tensornet][1x1][tier0]",
+                     "train_step[tensornet][1x1][tier1]"]
+    configs = [p.config for p in programs]
+    assert configs[0] == configs[1]  # shared contract, shapes aside
+    for prog in programs:
+        findings = run_passes(prog, get_passes())
+        assert error_count(findings) == 0, [f.render() for f in findings]
+
+
+@pytest.mark.tier1
+def test_pack_audit_cli(capsys):
+    pack_audit = _load_tool("pack_audit")
+    args = ["--n", "30", "--micro-batch", "4", "--tiers", "2",
+            "--max-atoms", "120", "--seed", "3"]
+    # generous bound, HBM priced and within budget: clean exit
+    assert pack_audit.main(
+        args + ["--hbm-budget-gb", "64", "--json"]) == 0
+    out = capsys.readouterr().out
+    import json
+
+    rep = json.loads(out)
+    assert rep["predicted_waste_naive"] >= rep["predicted_waste_packed"]
+    assert all("est_peak_bytes" in t and t["est_peak_bytes"] > 0
+               for t in rep["tiers"])
+    # impossible waste bound: exit 3 with the violation named
+    assert pack_audit.main(
+        args + ["--no-price-hbm", "--waste-bound", "0.0001"]) == 3
+    assert "VIOLATION" in capsys.readouterr().out
+    # usage error
+    assert pack_audit.main(["--n", "2", "--micro-batch", "8"]) == 2

@@ -420,6 +420,68 @@ def _trace_train_step(programs_out, want=_want_all):
             config=dict(coll_cfg)))
 
 
+def _trace_train_step_tiers(programs_out, want=_want_all):
+    """The TIERED cost-model train-step family (PR 15): one accumulated
+    step program per frozen capacity tier of a long-tail dataset, traced
+    through the same passes and the same collective budget as the
+    single-cap program. The pin: tier executables share the collective/
+    dtype/memory contracts — adding a capacity tier changes SHAPES, never
+    program structure, so per-tier contract drift is an ERROR here."""
+    names = ("train_step[tensornet][1x1][tier0]",
+             "train_step[tensornet][1x1][tier1]")
+    wanted = [n for n in names if want(n)]
+    if not wanted:
+        return
+    import jax
+    import numpy as np
+    import optax
+    from jax.experimental import enable_x64
+
+    from distmlip_tpu.analysis import Program
+    from distmlip_tpu.calculators import Atoms
+    from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+    from distmlip_tpu.train import (PackedBatchLoader, Sample, TrainConfig,
+                                    init_train_state, make_accum_train_step)
+
+    model = TensorNet(TensorNetConfig(
+        num_species=4, units=16, num_rbf=8, num_layers=2, cutoff=3.2,
+        dtype="bfloat16"))
+    params = model.init(jax.random.PRNGKey(0))
+    accum = 2
+    rng = np.random.default_rng(2)
+    samples = []
+    # long-tail: 4 small + 4 large structures so two tiers emerge
+    for reps in ((2, 2, 1), (4, 2, 2)):
+        cart, lattice, species = build_system(reps)
+        for _ in range(4):
+            pos = cart + rng.normal(0, 0.02, cart.shape)
+            samples.append(Sample(
+                Atoms(numbers=species + 1, positions=pos, cell=lattice),
+                float(rng.normal()),
+                rng.normal(0, 0.1, cart.shape).astype(np.float32)))
+    cfg = TrainConfig(accum_steps=accum, precision="bf16")
+    optimizer = optax.adam(1e-3)
+    loader = PackedBatchLoader(
+        samples, model.cfg.cutoff, micro_batch_size=2, accum_steps=accum,
+        species_fn=lambda z: (z - 1).astype("int32"), prefetch=0,
+        packing="cost_model", num_tiers=2)
+    state = init_train_state(optimizer, params, None, cfg, seed=0)
+    step = make_accum_train_step(model.energy_fn, optimizer, None, cfg)
+    firsts = loader.tier_first_steps()
+    for tier, first in sorted(firsts.items()):
+        name = f"train_step[tensornet][1x1][tier{tier}]"
+        if name not in wanted:
+            continue
+        batch = loader._build(0, first)
+        with enable_x64():
+            jx = jax.make_jaxpr(step)(state, batch.graphs, batch.targets)
+        programs_out.append(Program(
+            name=name, jaxpr=jx,
+            tags=frozenset({"grad", "x64", "train"}),
+            config={"max_total_collectives": 0}))
+    loader.close()
+
+
 def run_lint(paths=None):
     """Repo-specific AST lint + ruff (when installed) over the package."""
     from distmlip_tpu.analysis import lint_paths
@@ -528,6 +590,7 @@ def main(argv=None) -> int:
             if want("device_md[pair][1x1]"):
                 _trace_device_md(programs)
             _trace_train_step(programs, want)
+            _trace_train_step_tiers(programs, want)
         if args.hbm_budget_gb is not None:
             for prog in programs:
                 prog.config.setdefault(

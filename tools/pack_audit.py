@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Dataset packing audit: cost census, capacity tiers, predicted waste.
+
+    python tools/pack_audit.py [--n 200] [--seed 0] [--mu 3.0]
+        [--sigma 0.7] [--min-atoms 4] [--max-atoms 400] [--cutoff 3.5]
+        [--micro-batch 8] [--accum 1] [--batch-parts 1] [--tiers 3]
+        [--waste-bound F] [--no-price-hbm] [--hbm-budget-gb G] [--json]
+
+CI-runnable (no chip) audit of the cost-model packing pipeline
+(distmlip_tpu/train/packing.py) on a synthetic long-tail dataset:
+structure sizes drawn from a lognormal (``--mu``/``--sigma`` in
+log-atoms), built as perturbed crystals with random vacancies so the
+neighbor census is real, not synthetic. Prints:
+
+- the dataset's cost histogram (edges are the unit of work);
+- the chosen capacity tiers (thresholds, members, frozen caps);
+- each tier's HBM price — the PR 9 static planner's per-device peak
+  estimate of the tier's traced train-step executable (``--no-price-hbm``
+  skips the trace stage; ``--hbm-budget-gb`` turns the price into a gate);
+- predicted padding waste, naive single-cap vs cost-model tiers, through
+  THE shared slot-waste definition (``partition.slot_waste_frac``) — the
+  same numbers the loader's telemetry will report at train time.
+
+Exit codes: 0 clean; 2 usage error; 3 when the predicted cost-model
+waste exceeds ``--waste-bound``, or any tier's HBM price exceeds 90% of
+``--hbm-budget-gb``.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_UNIT = [[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]]
+
+
+def synth_longtail_samples(n: int, seed: int, mu: float, sigma: float,
+                           min_atoms: int, max_atoms: int,
+                           a: float = 3.9, n_species: int = 3):
+    """``n`` labeled structures whose atom counts follow a clipped
+    lognormal — perturbed fcc-like crystals with random vacancies, so
+    edge counts come from real neighbor geometry."""
+    import numpy as np
+
+    from distmlip_tpu import geometry
+    from distmlip_tpu.calculators import Atoms
+    from distmlip_tpu.train import Sample
+
+    rng = np.random.default_rng(seed)
+    unit = np.asarray(_UNIT, dtype=float)
+    sizes = np.clip(rng.lognormal(mu, sigma, n).round().astype(int),
+                    min_atoms, max_atoms)
+    samples = []
+    for n_at in sizes:
+        reps = max(int(np.ceil((n_at / len(unit)) ** (1.0 / 3.0))), 1)
+        frac, lattice = geometry.make_supercell(
+            unit, np.eye(3) * a, (reps, reps, reps))
+        cart = geometry.frac_to_cart(frac, lattice) + rng.normal(
+            0, 0.04, (len(frac), 3))
+        keep = np.sort(rng.choice(len(cart), size=int(n_at), replace=False))
+        atoms = Atoms(numbers=rng.integers(1, 1 + n_species, len(keep)),
+                      positions=cart[keep], cell=lattice)
+        samples.append(Sample(atoms, float(rng.normal()),
+                              rng.normal(0, 0.1, (len(keep), 3)).astype(
+                                  np.float32)))
+    return samples
+
+
+def price_tiers_hbm(samples, needs, cutoff: float, micro_batch: int,
+                    accum: int, batch_parts: int, tiers: int) -> dict:
+    """{tier: estimated per-device peak bytes} of each tier's train-step
+    executable — the exact production machinery (cost-model loader +
+    ``estimate_step_peak_bytes``) on a small TensorNet, traced
+    abstractly: no compile, no chip."""
+    import jax
+    import numpy as np
+    import optax
+
+    from distmlip_tpu.models.tensornet import TensorNet, TensorNetConfig
+    from distmlip_tpu.train import (PackedBatchLoader, TrainConfig,
+                                    estimate_step_peak_bytes,
+                                    init_train_state, make_accum_train_step)
+
+    model = TensorNet(TensorNetConfig(
+        num_species=4, units=16, num_rbf=6, num_layers=2, cutoff=cutoff))
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = TrainConfig(accum_steps=accum)
+    loader = PackedBatchLoader(
+        samples, cutoff, micro_batch_size=micro_batch, accum_steps=accum,
+        batch_parts=batch_parts, precomputed_needs=needs,
+        species_fn=lambda z: np.zeros(len(z), np.int32), prefetch=0,
+        packing="cost_model", num_tiers=tiers)
+    state = init_train_state(optax.adam(1e-3), params, None, cfg)
+    step = make_accum_train_step(model.energy_fn, optax.adam(1e-3), None,
+                                 cfg)
+    prices = {}
+    for tier, first in sorted(loader.tier_first_steps().items()):
+        batch = loader._build(0, first)
+        prices[tier] = int(estimate_step_peak_bytes(step, state, batch))
+    loader.close()
+    return prices
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pack_audit", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--n", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mu", type=float, default=3.0,
+                    help="lognormal mean of log atom count")
+    ap.add_argument("--sigma", type=float, default=0.7)
+    ap.add_argument("--min-atoms", type=int, default=4)
+    ap.add_argument("--max-atoms", type=int, default=400)
+    ap.add_argument("--cutoff", type=float, default=3.5)
+    ap.add_argument("--micro-batch", type=int, default=8)
+    ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--batch-parts", type=int, default=1)
+    ap.add_argument("--tiers", type=int, default=3)
+    ap.add_argument("--waste-bound", type=float, default=1.0,
+                    help="exit 3 when predicted cost-model waste exceeds "
+                         "this fraction")
+    ap.add_argument("--no-price-hbm", action="store_true",
+                    help="skip the per-tier HBM trace stage")
+    ap.add_argument("--hbm-budget-gb", type=float, default=None,
+                    help="per-device budget: exit 3 when any tier prices "
+                         "over 90%% of it")
+    ap.add_argument("--json", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+        if args.n < args.micro_batch * args.accum:
+            raise ValueError(
+                f"--n {args.n} cannot fill one accumulation window of "
+                f"{args.micro_batch * args.accum}")
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+    except ValueError as e:
+        print(f"usage error: {e}", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distmlip_tpu.partition import fixed_caps_for_batches
+    from distmlip_tpu.train import (CostCensus, assign_tiers,
+                                    plan_epoch, plan_epoch_naive,
+                                    predicted_plan_waste, structure_needs,
+                                    tier_caps)
+    from distmlip_tpu.train.packing import plan_edge_balance
+
+    B, A, Bp = args.micro_batch, args.accum, args.batch_parts
+    samples = synth_longtail_samples(args.n, args.seed, args.mu, args.sigma,
+                                     args.min_atoms, args.max_atoms)
+    needs = structure_needs([s.atoms for s in samples], args.cutoff)
+    census = CostCensus.from_needs(needs)
+    tier_of, thresholds = assign_tiers(census.costs, args.tiers,
+                                       min_members=B * A)
+    caps = tier_caps(needs, tier_of, B, Bp, accum_steps=A,
+                     costs=census.costs)
+    naive_caps = fixed_caps_for_batches(needs, -(-B // Bp))
+
+    plan = plan_epoch(census.costs, tier_of, seed=args.seed, epoch=0,
+                      micro_batch_size=B, accum_steps=A, batch_parts=Bp)
+    naive_plan = plan_epoch_naive(len(needs), seed=args.seed, epoch=0,
+                                  micro_batch_size=B, accum_steps=A)
+    waste_packed = predicted_plan_waste(needs, plan, caps, batch_parts=Bp)
+    waste_naive = predicted_plan_waste(
+        needs, naive_plan, {0: naive_caps}, batch_parts=Bp)
+
+    report = {
+        "n": args.n,
+        "census": {"mean_cost": float(census.costs.mean()),
+                   "max_cost": float(census.costs.max()),
+                   "skew": census.skew(), **census.percentiles()},
+        "tiers": [],
+        "naive_caps": naive_caps.as_dict(),
+        "predicted_waste_naive": waste_naive,
+        "predicted_waste_packed": waste_packed,
+        # None (JSON null), not inf: strict parsers reject Infinity
+        "waste_ratio": (waste_naive / waste_packed
+                        if waste_packed > 0 else None),
+        "edge_balance_naive": plan_edge_balance(census.costs, naive_plan),
+        "edge_balance_packed": plan_edge_balance(census.costs, plan),
+        "steps_per_epoch": len(plan),
+        "waste_bound": args.waste_bound,
+    }
+    import numpy as np
+
+    for t in sorted(caps):
+        members = int(np.sum(tier_of == t))
+        report["tiers"].append({
+            "tier": t, "members": members,
+            "max_cost": thresholds[t],
+            "caps": caps[t].as_dict(),
+            "windows_per_epoch": members // (B * A),
+        })
+
+    prices = {}
+    if not args.no_price_hbm:
+        prices = price_tiers_hbm(samples, needs, args.cutoff, B, A, Bp,
+                                 args.tiers)
+        for entry in report["tiers"]:
+            entry["est_peak_bytes"] = prices.get(entry["tier"], 0)
+
+    violations = []
+    if waste_packed > args.waste_bound:
+        violations.append(
+            f"predicted cost-model waste {waste_packed:.3f} exceeds "
+            f"--waste-bound {args.waste_bound:.3f}")
+    if args.hbm_budget_gb is not None and prices:
+        budget = args.hbm_budget_gb * 2 ** 30
+        for t, p in sorted(prices.items()):
+            if p > 0.9 * budget:
+                violations.append(
+                    f"tier {t} prices {p / 2**20:.1f} MiB per device — "
+                    f"over 90% of the {args.hbm_budget_gb:.2f} GiB budget")
+    report["violations"] = violations
+
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(census.render())
+        print(f"\nnaive single-cap: caps={naive_caps.as_dict()} "
+              f"predicted waste={waste_naive:.3f}")
+        ratio = report["waste_ratio"]
+        print(f"cost-model: {len(caps)} tier(s), {len(plan)} step(s)/epoch,"
+              f" predicted waste={waste_packed:.3f} "
+              + (f"({ratio:.2f}x reduction), " if ratio is not None
+                 else "(zero waste), ")
+              + f"edge balance {report['edge_balance_naive']:.2f} -> "
+              f"{report['edge_balance_packed']:.2f}")
+        for entry in report["tiers"]:
+            line = (f"  tier {entry['tier']}: members={entry['members']} "
+                    f"max_cost={entry['max_cost']:.3g} "
+                    f"windows/epoch={entry['windows_per_epoch']} "
+                    f"caps={entry['caps']}")
+            if "est_peak_bytes" in entry:
+                line += f" hbm={entry['est_peak_bytes'] / 2**20:.1f}MiB"
+            print(line)
+        for v in violations:
+            print(f"VIOLATION: {v}")
+    return 3 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

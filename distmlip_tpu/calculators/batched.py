@@ -28,6 +28,7 @@ import time
 
 import numpy as np
 
+from ..obs import profiling as _profiling
 from ..obs import runtime as obsrt
 from ..parallel import make_batched_potential_fn
 from ..partition import BucketPolicy, pack_structures
@@ -158,6 +159,11 @@ class BatchedPotential:
         self.last_stats: dict = {}
         self._step_counter = 0
         self._last_compile_count = 0
+        # compile telemetry of the most recent dispatch (obs/profiling):
+        # 0.0/"" on warm steps; "fresh" on a real trace+compile, "aot"
+        # when the fleet AOT dispatcher rehydrated the bucket
+        self._last_compile_s = 0.0
+        self._last_compile_kind = ""
         # memory-aware autobatching: per-device HBM budget + the static
         # planner's calibration (per compiled shape bucket)
         self.memory_model = bool(memory_model)
@@ -436,6 +442,7 @@ class BatchedPotential:
             tid = obsrt.current_trace_id()
             if tid is not None:
                 ann_name = f"{ann_name}[trace={tid}]"
+        cc0 = self.compile_count
         with annotate(ann_name):
             from ..kernels.dispatch import counting
 
@@ -501,6 +508,25 @@ class BatchedPotential:
         if aot is not None:
             self.last_stats["aot_rehydrated"] = bool(aot)
         self.last_bucket_key = self.last_stats.get("bucket_key", "")
+        # compile telemetry: the AOT dispatcher records its own events
+        # (with the true fresh/aot split — don't double-count); a plain
+        # jit potential records here when this dispatch grew the
+        # executable cache (a real trace+compile; kc.total can't serve —
+        # models without fused-dispatch sites count zero on fresh traces)
+        self._last_compile_s = 0.0
+        self._last_compile_kind = ""
+        if getattr(self._potential, "_records_compiles", False):
+            self._last_compile_s = float(getattr(
+                self._potential, "last_dispatch_compile_s", 0.0))
+            self._last_compile_kind = str(getattr(
+                self._potential, "last_dispatch_kind", ""))
+        elif self.compile_count > cc0:
+            self._last_compile_s = t3 - t2
+            self._last_compile_kind = _profiling.KIND_FRESH
+            _profiling.record_compile(
+                site="batched_bucket", kind=_profiling.KIND_FRESH,
+                wall_s=self._last_compile_s,
+                bucket_key=self.last_bucket_key)
         # bucket-cached peak estimate (cache hits reuse the compile-time
         # calibration) + headroom against the device limit/budget — ONE
         # backend memory-stats sweep serves both the headroom and the
@@ -539,6 +565,8 @@ class BatchedPotential:
             span_id=ctx[1] if ctx is not None else "",
             timings=dict(self.last_timings),
             compile_cache_size=cache_size, compiled=compiled,
+            compile_s=self._last_compile_s,
+            compile_kind=self._last_compile_kind,
             graph_reused=reused, rebuild=not reused,
             rebuild_count=int(not reused),
             rebuild_on_device=int(refreshed),

@@ -280,6 +280,7 @@ class DistPotential:
             self.telemetry = telemetry
 
     def _init_runtime(self):
+        t0 = time.perf_counter()
         self.mesh = (
             graph_mesh(self.num_partitions, self._devices)
             if self.num_partitions > 1 else None
@@ -297,6 +298,15 @@ class DistPotential:
                          halo_mode=self.halo_mode, kernels=self.kernels)
             if (self.compute_magmom and not fused) else None
         )
+        # compile telemetry: a runtime (re)build means the next dispatch
+        # re-traces — record the build itself so rebuild storms show up
+        # in the compile log even before the first dispatch
+        from ..obs import profiling as _profiling
+
+        _profiling.record_compile(
+            site="dist_build", kind=_profiling.KIND_FRESH,
+            wall_s=time.perf_counter() - t0,
+            bucket_key=f"P={self.num_partitions}")
 
     def _auto_partition_count(self, atoms: Atoms) -> int:
         """All devices, clamped so the planner's slab width stays above 2x
@@ -814,6 +824,19 @@ class DistPotential:
             last[kind] = cache_size
         timings = {**self.last_timings, "total_s": total_s,
                    **(extra_timings or {})}
+        # compile telemetry: the dispatch that grew this kind's executable
+        # cache carried the trace+lower+compile inside its device_s —
+        # stamp the record and feed the process compile log (obs plane)
+        compile_s = 0.0
+        compile_kind = ""
+        if compiled:
+            from ..obs import profiling as _profiling
+
+            compile_kind = _profiling.KIND_FRESH
+            compile_s = float(timings.get("device_s", 0.0))
+            _profiling.record_compile(
+                site="dist_potential", kind=compile_kind,
+                wall_s=compile_s, bucket_key=kind)
         import dataclasses
 
         # typed StepRecord fields passed through **extra (e.g. DeviceMD's
@@ -827,6 +850,7 @@ class DistPotential:
         rec = StepRecord(
             step=self._step_counter, kind=kind, timings=timings,
             compile_cache_size=cache_size, compiled=compiled,
+            compile_s=compile_s, compile_kind=compile_kind,
             device_memory=_device_memory_stats(),
             halo_mode=self.halo_mode,
             prefetch_skipped_hbm=self._prefetch_skip_hbm_flag,

@@ -39,6 +39,9 @@ import json
 import os
 import tempfile
 import threading
+import time
+
+from ..obs import profiling as _profiling
 
 
 _EXPORT_REGISTERED = False
@@ -123,6 +126,9 @@ class AotExecutableCache:
         self.saved = 0
         self.misses = 0
         self.errors = 0
+        # dispatches that fell through to a REAL jit trace+compile (the
+        # per-replica fresh-vs-aot split; rehydrated counts the aot side)
+        self.fresh_compiles = 0
 
     @classmethod
     def for_potential(cls, cache_dir: str, pot) -> "AotExecutableCache":
@@ -177,7 +183,8 @@ class AotExecutableCache:
     def stats(self) -> dict:
         with self._lock:
             return {"rehydrated": self.rehydrated, "saved": self.saved,
-                    "misses": self.misses, "errors": self.errors}
+                    "misses": self.misses, "errors": self.errors,
+                    "fresh_compiles": self.fresh_compiles}
 
 
 class _AotDispatcher:
@@ -191,6 +198,11 @@ class _AotDispatcher:
     (BatchedPotential plumbs it into ``last_stats``/telemetry as
     ``aot_rehydrated``)."""
 
+    # BatchedPotential checks this duck-type flag: the dispatcher records
+    # its own compile events (fresh AND aot, with the true split), so the
+    # batched layer must not double-record them
+    _records_compiles = True
+
     def __init__(self, jit_fn, cache: AotExecutableCache, save: bool = True):
         self._jit = jit_fn
         self._cache = cache
@@ -200,6 +212,11 @@ class _AotDispatcher:
         self._saved: set[str] = set()          # buckets exported this run
         self._lock = threading.Lock()
         self.last_dispatch_aot = False
+        # compile telemetry of the LAST dispatch (0.0/"" = warm, no
+        # compile happened); BatchedPotential stamps these onto the
+        # StepRecord as compile_s/compile_kind
+        self.last_dispatch_compile_s = 0.0
+        self.last_dispatch_kind = ""
 
     # BatchedPotential.compile_count reads this: only REAL jit traces
     # count — a rehydrated bucket must keep the counter at zero
@@ -212,6 +229,7 @@ class _AotDispatcher:
         from jax import export as jax_export
 
         _ensure_export_registrations()
+        t0 = time.perf_counter()
         data = self._cache.load(key)
         if data is None:
             with self._cache._lock:
@@ -228,17 +246,25 @@ class _AotDispatcher:
             return None
         with self._cache._lock:
             self._cache.rehydrated += 1
+        self.last_dispatch_compile_s = time.perf_counter() - t0
+        self.last_dispatch_kind = _profiling.KIND_AOT
+        _profiling.record_compile(
+            site="aot_dispatch", kind=_profiling.KIND_AOT,
+            wall_s=self.last_dispatch_compile_s, bucket_key=key,
+            executable_bytes=len(data))
         return fn
 
     def __call__(self, params, graph, positions):
         from ..partition.batch import bucket_key as _bucket_key
 
         key = _bucket_key(graph)
+        self.last_dispatch_compile_s = 0.0
+        self.last_dispatch_kind = ""
         with self._lock:
             fn = self._loaded.get(key)
             known_bad = key in self._failed
         if fn is None and not known_bad:
-            fn = self._rehydrate(key)
+            fn = self._rehydrate(key)   # stamps last_dispatch_* on success
             with self._lock:
                 if fn is not None:
                     self._loaded[key] = fn
@@ -255,8 +281,23 @@ class _AotDispatcher:
                     self._failed.add(key)
                 with self._cache._lock:
                     self._cache.errors += 1
+                self.last_dispatch_compile_s = 0.0
+                self.last_dispatch_kind = ""
         self.last_dispatch_aot = False
+        n0 = self._cache_size()
+        t0 = time.perf_counter()
         out = self._jit(params, graph, positions)
+        if self._cache_size() > n0:
+            # a REAL trace+lower+compile ran inside this dispatch (wall
+            # includes the bucket's first execution — same convention as
+            # the batched engine's compile-step device_s)
+            self.last_dispatch_compile_s = time.perf_counter() - t0
+            self.last_dispatch_kind = _profiling.KIND_FRESH
+            with self._cache._lock:
+                self._cache.fresh_compiles += 1
+            _profiling.record_compile(
+                site="aot_dispatch", kind=_profiling.KIND_FRESH,
+                wall_s=self.last_dispatch_compile_s, bucket_key=key)
         if self._save:
             with self._lock:
                 fresh = key not in self._saved

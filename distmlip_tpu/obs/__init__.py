@@ -20,6 +20,15 @@ untouched):
   active-loop buffer/swaps), served as Prometheus text exposition by
   :class:`MetricsServer` and snapshot-dumpable into bench JSON.
 
+- **Compiler/device** (:mod:`.profiling` / :mod:`.attribution` /
+  :mod:`.roofline`) — compile telemetry at every compile point (fresh
+  vs AOT-rehydrate, wall time, bucket key; ``distmlip_compile_seconds``
+  + ``distmlip_compiles_total{kind=}``), scope-level device-time
+  attribution from a profiler capture or the analytic cost model, and
+  roofline rows (intensity / achieved vs peak / MFU) joined from the
+  FLOP and memory planners. CLIs: ``tools/roofline.py`` and
+  ``tools/perf_gate.py`` (baseline regression gate).
+
 Plus the incident plane: :class:`~.slo.SLOMonitor` evaluates per-tenant
 multi-window burn rates and, on breach (or first deadline miss / replica
 wedge suspicion), the :class:`~.flight.FlightRecorder` captures traces +
@@ -43,11 +52,15 @@ jitted code is the DML003 lint violation (``contract_check --lint``).
 
 from __future__ import annotations
 
-from . import runtime
+from . import attribution, profiling, roofline, runtime
+from .attribution import ScopeBreakdown, attribute
 from .export import (critical_path_summary, critical_paths,
                      format_critical_path, load_trace, load_trace_dir,
                      request_trace_summary, to_trace_events, write_trace)
 from .flight import FlightRecorder
+from .profiling import (CompileEvent, compile_counts, compile_events,
+                        record_compile, reset_compile_log)
+from .roofline import RooflineRow, format_roofline_table
 from .metrics import (LATENCY_BUCKETS, MetricsRegistry, MetricsServer,
                       parse_exposition)
 from .runtime import hub, install, uninstall
@@ -158,4 +171,16 @@ __all__ = [
     "critical_paths",
     "critical_path_summary",
     "format_critical_path",
+    "profiling",
+    "attribution",
+    "roofline",
+    "CompileEvent",
+    "record_compile",
+    "compile_events",
+    "compile_counts",
+    "reset_compile_log",
+    "ScopeBreakdown",
+    "attribute",
+    "RooflineRow",
+    "format_roofline_table",
 ]

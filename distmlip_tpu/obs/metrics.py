@@ -16,6 +16,13 @@ no allocation after the first touch of a (family, labels) pair.
 Histogram buckets are FIXED log-scale latency buckets (100 µs .. ~104 s,
 x2 per rung) so percentile queries over the exposition are stable across
 restarts and tenants — pass ``buckets=`` for non-latency quantities.
+
+Label cardinality is BOUNDED: a registry-created family admits at most
+``max_label_children`` distinct label-value sets (default 64); further
+novel sets all route to one ``_other`` overflow child, and every routed
+update increments ``distmlip_metrics_label_overflow_total{metric=...}``
+— a tenant-id-per-request client degrades its own per-tenant resolution
+instead of growing the registry (and every scrape) without bound.
 """
 
 from __future__ import annotations
@@ -29,6 +36,12 @@ import threading
 LATENCY_BUCKETS = tuple(1e-4 * 2 ** i for i in range(21))
 
 _KINDS = ("counter", "gauge", "histogram")
+
+# default per-family cap on distinct label-value sets; the overflow
+# bucket label and the trip counter metric (exempt from its own cap)
+DEFAULT_MAX_LABEL_CHILDREN = 64
+OVERFLOW_LABEL = "_other"
+_OVERFLOW_METRIC = "distmlip_metrics_label_overflow_total"
 
 
 def _label_str(label_names, label_values) -> str:
@@ -104,7 +117,7 @@ class MetricFamily:
     """A named metric with a fixed label schema; children per value set."""
 
     def __init__(self, name: str, help: str, kind: str, label_names=(),
-                 buckets=None):
+                 buckets=None, max_children=None, registry=None):
         if kind not in _KINDS:
             raise ValueError(f"kind {kind!r} not in {_KINDS}")
         self.name = name
@@ -114,6 +127,13 @@ class MetricFamily:
         self.buckets = (tuple(buckets) if buckets is not None
                         else LATENCY_BUCKETS) if kind == "histogram" \
             else ()
+        # None = unbounded (directly-constructed families, tests); the
+        # registry passes its cap. The trip counter itself is exempt —
+        # its cardinality is bounded by the number of families anyway,
+        # and routing it to _other would recurse.
+        self._max_children = (None if name == _OVERFLOW_METRIC
+                              else max_children)
+        self._registry = registry
         self._lock = threading.Lock()
         self._children: dict[tuple, _Child] = {}
         self._default: _Child | None = None
@@ -128,11 +148,43 @@ class MetricFamily:
                 f"{self.name}: expected labels {self.label_names}, "
                 f"got {values}")
         child = self._children.get(values)
-        if child is None:
-            with self._lock:
-                child = self._children.setdefault(
-                    values, _Child(self, values))
+        if child is not None:
+            return child
+        overflowed = False
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                if (self._max_children is not None and self.label_names
+                        and len(self._children) >= self._max_children):
+                    # cap tripped: route this (and every further novel)
+                    # label set to the shared overflow child — each
+                    # routed update counts one overflow below
+                    overflowed = True
+                    key = (OVERFLOW_LABEL,) * len(self.label_names)
+                    child = self._children.get(key)
+                    if child is None:
+                        child = self._children[key] = _Child(self, key)
+                else:
+                    child = self._children[values] = _Child(self, values)
+        if overflowed:
+            # outside the family lock: the trip counter is ANOTHER
+            # family, and nesting the two locks would order-invert
+            # against a concurrent render()
+            self._note_overflow()
         return child
+
+    def _note_overflow(self) -> None:
+        reg = self._registry
+        if reg is None:
+            return
+        try:
+            reg.counter(
+                _OVERFLOW_METRIC,
+                "Updates routed to the _other overflow child because a "
+                "family hit its label-cardinality cap",
+                labels=("metric",)).labels(metric=self.name).inc()
+        except Exception:  # noqa: BLE001 - accounting must not raise
+            pass
 
     def _unlabeled(self) -> _Child:
         if self._default is None:
@@ -205,9 +257,10 @@ class MetricFamily:
 class MetricsRegistry:
     """Get-or-create families by name; render / snapshot the whole set."""
 
-    def __init__(self):
+    def __init__(self, max_label_children: int = DEFAULT_MAX_LABEL_CHILDREN):
         self._lock = threading.Lock()
         self._families: dict[str, MetricFamily] = {}
+        self.max_label_children = max_label_children
 
     def _family(self, name, help, kind, labels, buckets=None):
         fam = self._families.get(name)
@@ -215,8 +268,10 @@ class MetricsRegistry:
             with self._lock:
                 fam = self._families.get(name)
                 if fam is None:
-                    fam = MetricFamily(name, help, kind, labels,
-                                       buckets=buckets)
+                    fam = MetricFamily(
+                        name, help, kind, labels, buckets=buckets,
+                        max_children=self.max_label_children,
+                        registry=self)
                     self._families[name] = fam
         if fam.kind != kind:
             raise ValueError(

@@ -132,6 +132,10 @@ class StepRecord:
     prefetch_skipped_hbm: bool = False  # speculative build vetoed: HBM guard
     compile_cache_size: int = 0      # jit executable cache entries after step
     compiled: bool = False           # this step triggered an XLA compile
+    # --- compile telemetry (obs/profiling.py; meaningful when compiled
+    #     or aot_rehydrated — 0.0/"" on warm steps and in old JSONL) ---
+    compile_s: float = 0.0           # trace+lower+compile (or rehydrate) wall
+    compile_kind: str = ""           # "fresh" | "aot" | "" (no compile)
 
     # --- static HBM plan (analysis/memory.py; 0 = no estimate observed) ---
     # estimated per-device peak live bytes of the step's traced program

@@ -85,6 +85,11 @@ class Report:
             f"prefetch_adopted={c.get('prefetch_adopted', 0)} "
             f"compiles={c.get('compiles', 0)} "
             f"graph_reused={c.get('graph_reused', 0)}")
+        if "compiles_fresh" in c or "compiles_aot" in c:
+            out.append(
+                f"compile: fresh={c.get('compiles_fresh', 0)} "
+                f"aot_rehydrate={c.get('compiles_aot', 0)} "
+                f"wall={c.get('compile_time_s', 0.0):.3f}s")
         if "min_node_occupancy" in c:
             out.append(
                 f"occupancy: node min={c['min_node_occupancy']:.2f} "
@@ -126,6 +131,18 @@ class Report:
                 f"coverage mean={c['mean_kernel_coverage']:.2f}")
         if "mean_mfu" in c:
             out.append(f"mfu: mean={c['mean_mfu']:.3f} max={c['max_mfu']:.3f}")
+        if c.get("roofline"):
+            from ..obs.roofline import RooflineRow, format_roofline_table
+
+            rows = [RooflineRow(
+                program=d["program"], flops=d["flops"], bytes=d["bytes"],
+                time_s=d["time_s"], peak_flops=d["peak_flops"],
+                n_devices=d["n_devices"], source=d["source"])
+                for d in c["roofline"]]
+            out.append("")
+            out.append(format_roofline_table(
+                rows, title="roofline (record-derived; bytes = live-set "
+                "proxy — see tools/roofline.py for jaxpr-accurate rows):"))
         if c.get("buckets"):
             out.append("")
             out.append("batched buckets (shape-bucketed compile cache):")
@@ -300,6 +317,15 @@ def aggregate(
     c["prefetch_adopted"] = sum(r.prefetch_adopted for r in records)
     c["compiles"] = sum(r.compiled for r in records)
     c["graph_reused"] = sum(r.graph_reused for r in records)
+    # compile telemetry (obs/profiling.py): kind split + total wall paid
+    # compiling. getattr-safe — a round may mix writers, with only some
+    # records carrying the compile_s/compile_kind fields
+    kinds = [str(getattr(r, "compile_kind", "") or "") for r in records]
+    if any(kinds):
+        c["compiles_fresh"] = sum(k == "fresh" for k in kinds)
+        c["compiles_aot"] = sum(k == "aot" for k in kinds)
+        c["compile_time_s"] = sum(
+            float(getattr(r, "compile_s", 0.0) or 0.0) for r in records)
     node_occ = [r.node_occupancy for r in records if r.node_occupancy > 0]
     edge_occ = [r.edge_occupancy for r in records if r.edge_occupancy > 0]
     if node_occ and edge_occ:
@@ -364,6 +390,16 @@ def aggregate(
     if mfus:
         c["mean_mfu"] = sum(mfus) / len(mfus)
         c["max_mfu"] = max(mfus)
+    # roofline rows (obs/roofline.py): only when some producer stamped a
+    # FLOP estimate into extra — plain serving rounds yield none
+    try:
+        from ..obs.roofline import rows_from_records
+
+        rrows = rows_from_records(records)
+    except Exception:  # noqa: BLE001 - report must render regardless
+        rrows = []
+    if rrows:
+        c["roofline"] = [row.as_dict() for row in rrows]
     c["prefetch_skipped_hbm"] = sum(
         getattr(r, "prefetch_skipped_hbm", False) for r in records)
     # device memory + static HBM plan: occupancy through the SAME

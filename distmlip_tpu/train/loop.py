@@ -213,10 +213,24 @@ class Trainer:
         t0 = time.perf_counter()
         batch = self.loader.next_batch()
         t_data = time.perf_counter() - t0
+        cc0 = self.compile_count
         self.state, metrics = self.step_fn(self.state, batch.graphs,
                                            batch.targets)
         m = {k: float(v) for k, v in metrics.items()}  # blocks on device
         dt = time.perf_counter() - t0
+        # compile telemetry: a grown jit cache means THIS dispatch traced
+        # and compiled a new per-tier executable (wall includes the first
+        # execution — indistinguishable at this layer)
+        compile_s, compile_kind = 0.0, ""
+        if cc0 >= 0 and self.compile_count > cc0:
+            from ..obs import profiling as _profiling
+
+            compile_s = dt - t_data
+            compile_kind = _profiling.KIND_FRESH
+            _profiling.record_compile(
+                site="train_step", kind=compile_kind, wall_s=compile_s,
+                bucket_key=batch.meta.get(
+                    "bucket_key", f"tier={batch.meta.get('tier', 0)}"))
         epoch = int(batch.meta.get("epoch", 0))
         step_no = int(m.pop("step"))
         # cadence keys on the APPLIED-step transition: a nonfinite-skipped
@@ -274,6 +288,9 @@ class Trainer:
                     1.0 - tier_est / self.hbm_budget_bytes
                     if self.hbm_budget_bytes and tier_est
                     else 0.0),
+                compile_s=compile_s,
+                compile_kind=compile_kind,
+                compiled=bool(compile_kind),
             )
             if self.mesh is not None:
                 from ..parallel.mesh import mesh_shape

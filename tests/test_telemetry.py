@@ -277,6 +277,60 @@ def test_report_skips_corrupt_lines(tmp_path):
     assert len(read_jsonl(path)) == 1
 
 
+def test_report_mixed_compile_telemetry_records():
+    """A round mixing writers — some records carry the PR-16 compile
+    fields, some are old-writer JSONL without them — must aggregate and
+    render without KeyErrors, with the compile split counting only the
+    records that have it and percentiles unskewed by the absent fields."""
+    recs = [
+        StepRecord(step=0, compiled=True, compile_s=0.8,
+                   compile_kind="fresh",
+                   timings={"device_s": 0.9, "total_s": 0.95}),
+        StepRecord(step=1, compile_s=0.01, compile_kind="aot",
+                   timings={"device_s": 0.02, "total_s": 0.03}),
+    ]
+    # old-writer records: parsed from dicts WITHOUT the compile fields
+    recs += [StepRecord.from_dict(
+        {"step": 2 + i, "timings": {"device_s": 0.1, "total_s": 0.11}})
+        for i in range(8)]
+    rep = aggregate(recs)
+    assert rep.counters["compiles_fresh"] == 1
+    assert rep.counters["compiles_aot"] == 1
+    assert rep.counters["compile_time_s"] == pytest.approx(0.81)
+    # the old-writer majority keeps the warm percentile honest
+    assert rep.phases["device_s"]["p50_s"] == pytest.approx(0.1)
+    txt = rep.render()
+    assert "compile: fresh=1 aot_rehydrate=1" in txt
+
+
+def test_report_no_compile_fields_at_all():
+    """Pure old-writer rounds carry NO compile keys — the report omits
+    the section instead of inventing zeros."""
+    recs = [StepRecord.from_dict(
+        {"step": i, "timings": {"total_s": 0.1}}) for i in range(5)]
+    rep = aggregate(recs)
+    assert "compiles_fresh" not in rep.counters
+    assert "compile:" not in rep.render()
+
+
+def test_report_roofline_section_from_records():
+    """Records carrying FLOP estimates surface a roofline table in the
+    report; mixed groups without estimates degrade to fewer rows."""
+    recs = [
+        StepRecord(step=0, kind="batched_calculate", bucket_key="b1",
+                   timings={"device_s": 0.01, "total_s": 0.02},
+                   est_peak_bytes=10**6,
+                   extra={"flops_per_step": 1.0e9}),
+        StepRecord(step=1, kind="serve_batch",
+                   timings={"device_s": 0.005, "total_s": 0.01}),
+    ]
+    rep = aggregate(recs)
+    rows = rep.counters.get("roofline", [])
+    assert len(rows) == 1
+    assert rows[0]["program"] == "batched_calculate[b1]"
+    assert "roofline" in rep.render()
+
+
 # ---------------------------------------------------------------------------
 # disabled path: zero overhead
 # ---------------------------------------------------------------------------

@@ -394,9 +394,28 @@ def run_fleet(args) -> int:
         telemetry = Telemetry([JsonlSink(args.jsonl,
                                          max_bytes=args.jsonl_max_bytes)])
     policies = [BucketPolicy() for _ in range(args.fleet)]
+    # compile telemetry scoped to THIS run: fleet mode doubles as the
+    # fresh-vs-aot end-to-end check (obs/profiling.py)
+    from distmlip_tpu.obs import profiling as _profiling
+
+    _profiling.reset_compile_log()
+    potentials = [
+        BatchedPotential(model, params, caps=policies[i], skin=args.skin)
+        for i in range(args.fleet)]
+    aot_dir = None
+    if args.aot == "shared" and args.fleet >= 2:
+        import tempfile
+
+        from distmlip_tpu.fleet import install_aot_cache
+
+        aot_dir = tempfile.mkdtemp(prefix="distmlip_aot_")
+        for pot in potentials:
+            # a dir string -> per-replica cache instances sharing the
+            # directory (per-replica rehydrate/export counters)
+            install_aot_cache(pot, aot_dir)
     engines = [
         ServeEngine(
-            BatchedPotential(model, params, caps=policies[i], skin=args.skin),
+            potentials[i],
             max_batch=args.max_batch, max_wait_s=args.max_wait,
             max_queue=args.max_queue, admission="reject",
             telemetry=telemetry)
@@ -460,6 +479,25 @@ def run_fleet(args) -> int:
         a = base_pool[i % len(base_pool)].copy()
         a.positions = a.positions + rng.normal(0, 0.02, a.positions.shape)
         uniques.append(a)
+    # shared-AOT pre-warm: the FIRST replica compiles one bucket FRESH
+    # (and exports it to the shared dir); every later replica then
+    # REHYDRATES the same bucket — so a --fleet >= 2 run always observes
+    # both compile kinds end-to-end. Serialized per replica (drain
+    # between) so the export lands before the next replica looks it up.
+    # Direct engine submissions count toward the span-conservation gate
+    # exactly like the active warm phase below.
+    if aot_dir is not None:
+        for rep in router.replicas.values():
+            if not rep.alive:
+                continue
+            a = base_pool[0].copy()
+            a.positions = a.positions + rng.normal(0, 0.01,
+                                                   a.positions.shape)
+            n_submitted += 1
+            f = rep.engine.submit(a)
+            rep.engine.drain(timeout=120)
+            f.result(timeout=300)
+
     futs, t_sub = [], []
     killed = reclaimed = 0
     t0 = time.perf_counter()
@@ -601,6 +639,25 @@ def run_fleet(args) -> int:
         scraped_ok, scraped = scrape_metrics(metrics_server, expected)
         metrics_server.close()
 
+    # compile-telemetry split: the in-process compile log and the metrics
+    # registry are two independent observers of the same events — the
+    # --check gate below requires them to agree
+    kind_counts = _profiling.compile_counts()
+    metric_kind_totals: dict = {}
+    if hub is not None:
+        from distmlip_tpu.obs import parse_exposition
+
+        for line, v in parse_exposition(hub.metrics.render()).items():
+            if not line.startswith("distmlip_compiles_total{"):
+                continue
+            for part in line[line.index("{") + 1:
+                             line.index("}")].split(","):
+                k, _, val = part.partition("=")
+                if k.strip() == "kind":
+                    kind = val.strip().strip('"')
+                    metric_kind_totals[kind] = (
+                        metric_kind_totals.get(kind, 0) + int(v))
+
     n_atoms = [len(a) for a in uniques]
     bound = args.fleet * policies[0].ladder_bound(
         min(n_atoms), sum(sorted(n_atoms)[-args.max_batch:]),
@@ -625,6 +682,13 @@ def run_fleet(args) -> int:
         "tenants": snap["tenants"],
         "replicas": snap["replicas"],
         "cache": snap["cache"],
+        "compile_events": {
+            "kinds": kind_counts,
+            "metrics_kinds": metric_kind_totals,
+            "aot": ({f"r{i}": pot.aot_cache.stats()
+                     for i, pot in enumerate(potentials)}
+                    if aot_dir is not None else None),
+        },
     }
     if loop is not None:
         summary["active"] = {
@@ -659,6 +723,17 @@ def run_fleet(args) -> int:
         }
         if args.chaos == "kill-replica":
             checks["failover_observed"] = snap["stats"]["failovers"] >= 1
+        if aot_dir is not None:
+            # the compile-telemetry contract: a shared-cache fleet run
+            # pays BOTH kinds — a fresh compile on the first replica and
+            # an AOT rehydrate on every later one
+            checks["compile_kinds_observed"] = (
+                kind_counts.get("fresh", 0) > 0
+                and kind_counts.get("aot", 0) > 0)
+        if hub is not None:
+            # the log and the registry saw the same events
+            checks["compile_metrics_consistent"] = (
+                metric_kind_totals == dict(kind_counts))
         if loop is not None:
             # the hot-swap contract: a mid-burst swap loses ZERO requests
             # and triggers ZERO recompiles on any replica
@@ -744,6 +819,12 @@ def main(argv=None) -> int:
                    help="fleet mode: kill replica r0 mid-burst; --check "
                         "then also requires a failover and still zero "
                         "lost requests")
+    p.add_argument("--aot", choices=("shared", "off"), default="shared",
+                   help="fleet mode: shared on-disk AOT executable cache "
+                        "across the replicas (fleet/aot.py) — the first "
+                        "replica to compile a bucket exports it, the "
+                        "others rehydrate; 'off' = every replica compiles "
+                        "its own buckets")
     p.add_argument("--cache-bytes", type=int, default=64 * 2**20,
                    help="fleet mode: result-cache byte bound")
     p.add_argument("--p99-bound-s", type=float, default=60.0,
